@@ -1,0 +1,9 @@
+"""Model library: Perceiver encoder/decoder/IO/MLM and text masking."""
+
+from perceiver_tpu.models.perceiver import (  # noqa: F401
+    PerceiverEncoder,
+    PerceiverDecoder,
+    PerceiverIO,
+    PerceiverMLM,
+)
+from perceiver_tpu.models.masking import TextMasking  # noqa: F401

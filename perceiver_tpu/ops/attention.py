@@ -1,0 +1,163 @@
+"""Multi-head attention as einsum over the MXU.
+
+Re-expresses the reference's ``nn.MultiheadAttention`` wrapper
+(``perceiver/model.py:59-74``) — including the asymmetric ``kdim``/
+``vdim`` path used by cross-attention, ``key_padding_mask`` /
+``attn_mask`` forwarding, and dropout on attention weights — as pure
+einsum-based functions:
+
+- q is projected from ``q_dim`` (the embedding dim), k from ``k_dim``,
+  v from ``v_dim``, all to ``q_dim``; output projection maps back to
+  ``q_dim``. This matches torch's separate q/k/v projection weights
+  when ``kdim``/``vdim`` differ from ``embed_dim``.
+- ``key_padding_mask`` is boolean ``(B, Lk)``, True at padding
+  positions (reference ``data/imdb.py:64``); masked logits get a large
+  negative additive bias before the fp32 softmax.
+- Attention-weight dropout matches torch's placement (after softmax).
+
+Cross-attention (``perceiver/model.py:77-99``) pre-norms both q and kv;
+self-attention (``model.py:102-116``) pre-norms its single input. The
+embedding dim equals the number of q channels — the reference's stated
+simplification vs. the paper (``model.py:78-82``).
+
+Shapes are static and heads are a named einsum axis, so XLA tiles the
+two batched matmuls straight onto the MXU and fuses scale/mask/softmax
+between them. A fused Pallas kernel (``perceiver_tpu.ops.pallas_attention``)
+can replace the softmax path for long-kv shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.dropout import dropout
+from perceiver_tpu.ops.initializers import xavier_uniform
+from perceiver_tpu.ops.linear import linear_init, linear_apply
+from perceiver_tpu.ops.norm import layer_norm_init, layer_norm_apply
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+NEG_INF = -1e30  # large-negative bias; safe in fp32 softmax accumulation
+
+
+def mha_init(key, q_dim: int, num_heads: int,
+             k_dim: Optional[int] = None, v_dim: Optional[int] = None,
+             dtype=jnp.float32):
+    """Init q/k/v/out projections (torch MultiheadAttention scheme)."""
+    if q_dim % num_heads != 0:
+        raise ValueError(f"q_dim {q_dim} not divisible by num_heads {num_heads}")
+    k_dim = q_dim if k_dim is None else k_dim
+    v_dim = q_dim if v_dim is None else v_dim
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    out = linear_init(ko, q_dim, q_dim, dtype)
+    return {
+        # torch: xavier-uniform projection weights, zero in-proj bias
+        "q": {"w": xavier_uniform(kq, (q_dim, q_dim), dtype),
+              "b": jnp.zeros((q_dim,), dtype)},
+        "k": {"w": xavier_uniform(kk, (k_dim, q_dim), dtype),
+              "b": jnp.zeros((q_dim,), dtype)},
+        "v": {"w": xavier_uniform(kv, (v_dim, q_dim), dtype),
+              "b": jnp.zeros((q_dim,), dtype)},
+        "out": {"w": out["w"], "b": jnp.zeros((q_dim,), dtype)},
+    }
+
+
+def _split_heads(x, num_heads: int):
+    b, l, e = x.shape
+    return x.reshape(b, l, num_heads, e // num_heads)
+
+
+def mha_apply(params, q, k, v, *, num_heads: int,
+              key_padding_mask=None, attn_mask=None,
+              dropout_rate: float = 0.0, rng=None, deterministic: bool = True,
+              policy: Policy = DEFAULT_POLICY):
+    """Scaled dot-product multi-head attention.
+
+    q: (B, Lq, q_dim); k: (B, Lk, k_dim); v: (B, Lk, v_dim).
+    key_padding_mask: (B, Lk) bool, True at padding.
+    attn_mask: (Lq, Lk) or (B, Lq, Lk); bool (True = masked) or additive.
+    Returns (B, Lq, q_dim).
+    """
+    qh = _split_heads(linear_apply(params["q"], q, policy=policy), num_heads)
+    kh = _split_heads(linear_apply(params["k"], k, policy=policy), num_heads)
+    vh = _split_heads(linear_apply(params["v"], v, policy=policy), num_heads)
+
+    head_dim = qh.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, policy.norm_dtype))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                        preferred_element_type=policy.norm_dtype)
+    logits = logits.astype(policy.norm_dtype) * scale
+
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            bias = jnp.where(attn_mask, NEG_INF, 0.0).astype(policy.norm_dtype)
+        else:
+            bias = attn_mask.astype(policy.norm_dtype)
+        if bias.ndim == 2:
+            bias = bias[None, None, :, :]
+        elif bias.ndim == 3:
+            bias = bias[:, None, :, :]
+        logits = logits + bias
+    if key_padding_mask is not None:
+        pad = key_padding_mask[:, None, None, :]  # (B,1,1,Lk)
+        logits = jnp.where(pad, NEG_INF, logits)
+
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights = dropout(weights, dropout_rate, rng=rng,
+                      deterministic=deterministic)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights.astype(policy.compute_dtype),
+                     vh)
+    b, lq = out.shape[0], out.shape[1]
+    out = out.reshape(b, lq, num_heads * head_dim)
+    return linear_apply(params["out"], out, policy=policy)
+
+
+# --- pre-norm cross/self attention (reference model.py:77-116) ---------------
+
+
+def cross_attention_init(key, num_q_channels: int, num_kv_channels: int,
+                         num_heads: int, dtype=jnp.float32):
+    return {
+        "norm_q": layer_norm_init(num_q_channels, dtype),
+        "norm_kv": layer_norm_init(num_kv_channels, dtype),
+        "mha": mha_init(key, num_q_channels, num_heads,
+                        k_dim=num_kv_channels, v_dim=num_kv_channels,
+                        dtype=dtype),
+    }
+
+
+def cross_attention_apply(params, x_q, x_kv, *, num_heads: int,
+                          key_padding_mask=None, attn_mask=None,
+                          dropout_rate: float = 0.0, rng=None,
+                          deterministic: bool = True,
+                          policy: Policy = DEFAULT_POLICY):
+    """Pre-norm on q AND kv, then MHA (reference model.py:97-99)."""
+    xq = layer_norm_apply(params["norm_q"], x_q, policy=policy)
+    xkv = layer_norm_apply(params["norm_kv"], x_kv, policy=policy)
+    return mha_apply(params["mha"], xq, xkv, xkv, num_heads=num_heads,
+                     key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+                     dropout_rate=dropout_rate, rng=rng,
+                     deterministic=deterministic, policy=policy)
+
+
+def self_attention_init(key, num_channels: int, num_heads: int,
+                        dtype=jnp.float32):
+    return {
+        "norm": layer_norm_init(num_channels, dtype),
+        "mha": mha_init(key, num_channels, num_heads, dtype=dtype),
+    }
+
+
+def self_attention_apply(params, x, *, num_heads: int,
+                         key_padding_mask=None, attn_mask=None,
+                         dropout_rate: float = 0.0, rng=None,
+                         deterministic: bool = True,
+                         policy: Policy = DEFAULT_POLICY):
+    """Pre-norm then MHA with q = k = v (reference model.py:110-116)."""
+    xn = layer_norm_apply(params["norm"], x, policy=policy)
+    return mha_apply(params["mha"], xn, xn, xn, num_heads=num_heads,
+                     key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+                     dropout_rate=dropout_rate, rng=rng,
+                     deterministic=deterministic, policy=policy)

@@ -1,0 +1,446 @@
+"""WordPiece tokenizer: normalize → pre-tokenize → encode/decode/train.
+
+Drop-in replacement for the surface of the Rust HF ``tokenizers``
+library the reference uses (``perceiver/tokenizer.py``,
+``data/imdb.py:52-68``): ``encode``/``encode_batch`` with padding and
+truncation, ``decode`` with WordPiece cleanup, ``token_to_id``,
+``get_vocab_size``, ``save``/``from_file`` — and reads/writes the same
+JSON file format, byte-compatible with the shipped
+``.cache/imdb-tokenizer-10003.json`` (verified by parity tests).
+
+Pipeline parity:
+
+- normalizers: ``Replace(pattern, content)`` (IMDB passes
+  ``Replace('<br />', ' ')``, ``data/imdb.py:101``), then NFD →
+  Lowercase → StripAccents (``tokenizer.py:37``).
+- pre-tokenizer: HF ``Whitespace`` — the regex ``\\w+|[^\\w\\s]+``.
+- model: greedy longest-match WordPiece with ``##`` continuation
+  prefix, ``max_input_chars_per_word=100``, ``[UNK]`` fallback.
+- trainer: likelihood-scored pair merging (the algorithm behind HF's
+  ``WordPieceTrainer``): score = freq(pair) / (freq(a) · freq(b)).
+
+This module is the pure-Python engine; when the compiled C++ core
+(``perceiver_tpu/tokenizer/csrc``) is available it transparently takes
+over encode/train hot paths (see ``perceiver_tpu.tokenizer.native``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import unicodedata
+from typing import Iterable, List, Optional, Sequence
+
+from perceiver_tpu.tokenizer.vocab import (
+    PAD_TOKEN,
+    PAD_TOKEN_ID,
+    UNK_TOKEN,
+    SPECIAL_TOKENS,
+)
+
+_WHITESPACE_RE = re.compile(r"\w+|[^\w\s]+")
+
+# HF WordPiece decoder cleanup=true replacements, applied PER TOKEN
+# (after the leading space is attached) — not on the joined string;
+# the rule list mirrors tokenizers' decoders::wordpiece::cleanup.
+_CLEANUP = [(" .", "."), (" ?", "?"), (" !", "!"), (" ,", ","),
+            (" ' ", "'"), (" n't", "n't"), (" 'm", "'m"),
+            (" do not", " don't"), (" 's", "'s"), (" 've", "'ve"),
+            (" 're", "'re")]
+
+
+def _cleanup_token(s: str) -> str:
+    for a, b in _CLEANUP:
+        s = s.replace(a, b)
+    return s
+
+
+# --- normalizers -------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Replace:
+    pattern: str
+    content: str
+
+    def __call__(self, text: str) -> str:
+        return text.replace(self.pattern, self.content)
+
+    def to_json(self):
+        return {"type": "Replace", "pattern": {"String": self.pattern},
+                "content": self.content}
+
+
+class NFD:
+    def __call__(self, text: str) -> str:
+        return unicodedata.normalize("NFD", text)
+
+    def to_json(self):
+        return {"type": "NFD"}
+
+
+class Lowercase:
+    def __call__(self, text: str) -> str:
+        return text.lower()
+
+    def to_json(self):
+        return {"type": "Lowercase"}
+
+
+class StripAccents:
+    def __call__(self, text: str) -> str:
+        return "".join(c for c in text if unicodedata.category(c) != "Mn")
+
+    def to_json(self):
+        return {"type": "StripAccents"}
+
+
+def _normalizer_from_json(spec) -> object:
+    t = spec["type"]
+    if t == "Replace":
+        return Replace(spec["pattern"]["String"], spec["content"])
+    if t == "NFD":
+        return NFD()
+    if t == "Lowercase":
+        return Lowercase()
+    if t == "StripAccents":
+        return StripAccents()
+    raise ValueError(f"Unsupported normalizer: {t}")
+
+
+# --- encoding result ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Encoding:
+    ids: List[int]
+    tokens: List[str]
+
+    @property
+    def attention_mask(self) -> List[int]:
+        return [0 if t == PAD_TOKEN else 1 for t in self.tokens]
+
+
+# --- tokenizer ---------------------------------------------------------------
+
+
+class WordPieceTokenizer:
+    """Normalize → whitespace pre-tokenize → greedy WordPiece."""
+
+    def __init__(self, vocab: Optional[dict] = None,
+                 normalizers: Sequence[object] = (),
+                 unk_token: str = UNK_TOKEN,
+                 continuing_subword_prefix: str = "##",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = dict(vocab or {})
+        self.ids_to_tokens = {i: t for t, i in self.vocab.items()}
+        self.normalizers = list(normalizers)
+        self.unk_token = unk_token
+        self.prefix = continuing_subword_prefix
+        self.max_input_chars_per_word = max_input_chars_per_word
+        self._padding = None  # (pad_id, pad_token) when enabled
+        self._truncation = None  # max_length when enabled
+
+    # -- vocabulary access (HF surface) --
+
+    def get_vocab_size(self) -> int:
+        return len(self.vocab)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self.vocab.get(token)
+
+    def id_to_token(self, i: int) -> Optional[str]:
+        return self.ids_to_tokens.get(i)
+
+    # -- padding / truncation (HF surface, data/imdb.py:54-57) --
+
+    def enable_padding(self, pad_id: int = PAD_TOKEN_ID,
+                       pad_token: str = PAD_TOKEN):
+        self._padding = (pad_id, pad_token)
+
+    def no_padding(self):
+        self._padding = None
+
+    def enable_truncation(self, max_length: int):
+        self._truncation = max_length
+
+    def no_truncation(self):
+        self._truncation = None
+
+    # -- pipeline --
+
+    def normalize(self, text: str) -> str:
+        for n in self.normalizers:
+            text = n(text)
+        return text
+
+    @staticmethod
+    def pre_tokenize(text: str) -> List[str]:
+        return _WHITESPACE_RE.findall(text)
+
+    def _encode_word(self, word: str) -> List[str]:
+        if len(word) > self.max_input_chars_per_word:
+            return [self.unk_token]
+        pieces, start = [], 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = self.prefix + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    def _added_token_re(self) -> Optional[re.Pattern]:
+        specials = [t for t in SPECIAL_TOKENS if t in self.vocab]
+        if not specials:
+            return None
+        return re.compile("|".join(re.escape(t) for t in specials))
+
+    def encode(self, text: str) -> Encoding:
+        # Added special tokens (non-normalized) are matched on the raw
+        # input before the normalizer runs — HF added_tokens semantics;
+        # this is what lets '[MASK]' in a raw string survive lowercasing
+        # (the reference's predict_masked_samples path, utils.py:27).
+        tokens: List[str] = []
+        pattern = self._added_token_re()
+        segments = ([text] if pattern is None
+                    else self._split_on_added(text, pattern))
+        for seg in segments:
+            if seg in self.vocab and pattern is not None \
+                    and pattern.fullmatch(seg):
+                tokens.append(seg)
+                continue
+            for word in self.pre_tokenize(self.normalize(seg)):
+                tokens.extend(self._encode_word(word))
+        if self._truncation is not None:
+            tokens = tokens[:self._truncation]
+        ids = [self.vocab[t] for t in tokens]
+        return Encoding(ids=ids, tokens=tokens)
+
+    @staticmethod
+    def _split_on_added(text: str, pattern: re.Pattern) -> List[str]:
+        out, last = [], 0
+        for m in pattern.finditer(text):
+            if m.start() > last:
+                out.append(text[last:m.start()])
+            out.append(m.group(0))
+            last = m.end()
+        if last < len(text):
+            out.append(text[last:])
+        return out
+
+    def encode_batch(self, texts: Sequence[str]) -> List[Encoding]:
+        encs = [self.encode(t) for t in texts]
+        if self._padding is not None and encs:
+            pad_id, pad_token = self._padding
+            width = max(len(e.ids) for e in encs)
+            for e in encs:
+                n = width - len(e.ids)
+                e.ids.extend([pad_id] * n)
+                e.tokens.extend([pad_token] * n)
+        return encs
+
+    def decode(self, ids: Iterable[int],
+               skip_special_tokens: bool = True) -> str:
+        tokens = []
+        for i in ids:
+            t = self.ids_to_tokens.get(int(i))
+            if t is None:
+                continue
+            if skip_special_tokens and t in SPECIAL_TOKENS:
+                continue
+            tokens.append(t)
+        out = []
+        for j, t in enumerate(tokens):
+            if t.startswith(self.prefix):
+                s = t[len(self.prefix):]
+            elif j > 0:
+                s = " " + t
+            else:
+                s = t
+            out.append(_cleanup_token(s))  # decoder cleanup=true, per token
+        return "".join(out)
+
+    # -- persistence (HF-compatible JSON) --
+
+    def to_json(self) -> dict:
+        return {
+            "version": "1.0",
+            "truncation": None,
+            "padding": None,
+            "added_tokens": [
+                {"id": self.vocab[t], "special": True, "content": t,
+                 "single_word": False, "lstrip": False, "rstrip": False,
+                 "normalized": False}
+                for t in SPECIAL_TOKENS if t in self.vocab],
+            "normalizer": {
+                "type": "Sequence",
+                "normalizers": [n.to_json() for n in self.normalizers]},
+            "pre_tokenizer": {"type": "Whitespace"},
+            "post_processor": None,
+            "decoder": {"type": "WordPiece", "prefix": self.prefix,
+                        "cleanup": True},
+            "model": {
+                "type": "WordPiece",
+                "unk_token": self.unk_token,
+                "continuing_subword_prefix": self.prefix,
+                "max_input_chars_per_word": self.max_input_chars_per_word,
+                "vocab": self.vocab,
+            },
+        }
+
+    def save(self, path: str):
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_json(), f, ensure_ascii=False)
+
+    @classmethod
+    def from_file(cls, path: str) -> "WordPieceTokenizer":
+        with open(path, encoding="utf-8") as f:
+            spec = json.load(f)
+        norm = spec.get("normalizer") or {"type": "Sequence",
+                                          "normalizers": []}
+        if norm["type"] == "Sequence":
+            normalizers = [_normalizer_from_json(n)
+                           for n in norm["normalizers"]]
+        else:
+            normalizers = [_normalizer_from_json(norm)]
+        model = spec["model"]
+        if model["type"] != "WordPiece":
+            raise ValueError(f"Unsupported model: {model['type']}")
+        return cls(
+            vocab=model["vocab"], normalizers=normalizers,
+            unk_token=model.get("unk_token", UNK_TOKEN),
+            continuing_subword_prefix=model.get("continuing_subword_prefix",
+                                                "##"),
+            max_input_chars_per_word=model.get("max_input_chars_per_word",
+                                               100))
+
+    # -- training --
+
+    def train_from_iterator(self, data: Iterable[str], trainer:
+                            "WordPieceTrainer"):
+        trainer.train(self, data)
+
+
+@dataclasses.dataclass
+class WordPieceTrainer:
+    """Likelihood-scored merge training (HF WordPieceTrainer algorithm).
+
+    Builds the initial alphabet (plain and ``##``-prefixed forms), then
+    repeatedly merges the adjacent pair maximizing
+    ``freq(pair) / (freq(a) * freq(b))`` until ``vocab_size``.
+    """
+
+    vocab_size: int
+    special_tokens: Sequence[str] = dataclasses.field(
+        default_factory=lambda: list(SPECIAL_TOKENS))
+    min_frequency: int = 0
+
+    def train(self, tokenizer: WordPieceTokenizer, data: Iterable[str]):
+        try:
+            from perceiver_tpu.tokenizer.native import native_train
+            vocab = native_train(tokenizer, data, self.vocab_size,
+                                 list(self.special_tokens),
+                                 self.min_frequency)
+        except (ImportError, OSError):
+            vocab = self._train_py(tokenizer, data)
+        tokenizer.vocab = vocab
+        tokenizer.ids_to_tokens = {i: t for t, i in vocab.items()}
+
+    def _train_py(self, tokenizer: WordPieceTokenizer,
+                  data: Iterable[str]) -> dict:
+        from collections import Counter
+        prefix = tokenizer.prefix
+
+        word_counts: Counter = Counter()
+        for text in data:
+            for w in tokenizer.pre_tokenize(tokenizer.normalize(text)):
+                word_counts[w] += 1
+
+        vocab: dict = {}
+        for t in self.special_tokens:
+            vocab[t] = len(vocab)
+
+        # Initial alphabet: first chars plain, continuation chars ##'d.
+        alphabet = set()
+        for w in word_counts:
+            alphabet.add(w[0])
+            alphabet.update(prefix + c for c in w[1:])
+        for s in sorted(alphabet):
+            if s not in vocab:
+                vocab[s] = len(vocab)
+
+        # Each word as a list of current symbols.
+        words = {w: [w[0]] + [prefix + c for c in w[1:]]
+                 for w in word_counts}
+
+        while len(vocab) < self.vocab_size:
+            pair_freq: Counter = Counter()
+            sym_freq: Counter = Counter()
+            for w, syms in words.items():
+                c = word_counts[w]
+                for s in syms:
+                    sym_freq[s] += c
+                for a, b in zip(syms, syms[1:]):
+                    pair_freq[(a, b)] += c
+            if not pair_freq:
+                break
+            best, best_score = None, None
+            for pair, f in pair_freq.items():
+                if f < max(self.min_frequency, 1):
+                    continue
+                score = f / (sym_freq[pair[0]] * sym_freq[pair[1]])
+                if best_score is None or score > best_score or (
+                        score == best_score and pair < best):
+                    best, best_score = pair, score
+            if best is None:
+                break
+            a, b = best
+            merged = a + (b[len(prefix):] if b.startswith(prefix) else b)
+            if merged not in vocab:
+                vocab[merged] = len(vocab)
+            for w, syms in words.items():
+                j, out = 0, []
+                while j < len(syms):
+                    if (j + 1 < len(syms) and syms[j] == a
+                            and syms[j + 1] == b):
+                        out.append(merged)
+                        j += 2
+                    else:
+                        out.append(syms[j])
+                        j += 1
+                words[w] = out
+        return vocab
+
+
+# --- factory functions (reference tokenizer.py:22-40) ------------------------
+
+
+def create_tokenizer(*normalizers) -> WordPieceTokenizer:
+    return WordPieceTokenizer(
+        normalizers=list(normalizers) + [NFD(), Lowercase(), StripAccents()])
+
+
+def load_tokenizer(path: str) -> WordPieceTokenizer:
+    return WordPieceTokenizer.from_file(path)
+
+
+def save_tokenizer(tokenizer: WordPieceTokenizer, path: str):
+    tokenizer.save(path)
+
+
+def train_tokenizer(tokenizer: WordPieceTokenizer, data: Iterable[str],
+                    vocab_size: int):
+    trainer = WordPieceTrainer(vocab_size=vocab_size,
+                               special_tokens=SPECIAL_TOKENS)
+    tokenizer.train_from_iterator(data, trainer)

@@ -1,0 +1,22 @@
+"""Test environment: force an 8-device virtual CPU backend.
+
+Runs before test collection imports anything heavy (SURVEY.md §4 test
+plan item (c)): distributed tests exercise real pjit/Mesh code paths on
+8 fake CPU devices, the idiomatic JAX substitute for a pod slice in CI.
+
+The container's sitecustomize registers the ``axon`` TPU plugin and
+pins ``JAX_PLATFORMS=axon`` before conftest runs, so setting the env
+var here is not enough — the config flag must be overridden after the
+jax import (backend selection happens lazily on first device use).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

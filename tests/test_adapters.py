@@ -1,0 +1,115 @@
+"""Adapter tests: Fourier channel counts/values, text embedding, outputs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_tpu.adapters import (
+    ImageInputAdapter,
+    TextInputAdapter,
+    ClassificationOutputAdapter,
+    SemanticSegOutputAdapter,
+    TextOutputAdapter,
+)
+from perceiver_tpu.ops import Policy
+from perceiver_tpu.ops.fourier import fourier_position_encodings
+
+FP32 = Policy.fp32()
+
+
+def test_fourier_channel_count_mnist():
+    # MNIST 28x28x1 with 32 bands -> 1 + 2*(2*32+1) = 131 channels
+    # (SURVEY.md §2.2; reference adapter.py:96-97).
+    a = ImageInputAdapter(image_shape=(28, 28, 1), num_frequency_bands=32)
+    assert a.num_input_channels == 131
+
+
+def test_fourier_encoding_values():
+    """Spot-check against a direct computation of the reference formula."""
+    enc = fourier_position_encodings((4, 6), num_bands=3)
+    assert enc.shape == (24, 2 * (2 * 3 + 1))
+    # positions first: rows iterate dim-0-major (meshgrid 'ij')
+    xs = np.linspace(-1, 1, 4)
+    ys = np.linspace(-1, 1, 6)
+    np.testing.assert_allclose(enc[0, :2], [xs[0], ys[0]], atol=1e-7)
+    np.testing.assert_allclose(enc[7, :2], [xs[1], ys[1]], atol=1e-7)
+    # frequencies: linspace(1, max_freq/2, bands) with max_freq = dim size
+    fx = np.linspace(1.0, 4 / 2, 3)
+    expected_sin_x = np.sin(np.pi * fx * xs[1])
+    np.testing.assert_allclose(enc[7, 2:5], expected_sin_x, atol=1e-6)
+    # cosines follow all sins
+    fy = np.linspace(1.0, 6 / 2, 3)
+    expected_cos_y = np.cos(np.pi * fy * ys[1])
+    np.testing.assert_allclose(enc[7, 11:14], expected_cos_y, atol=1e-6)
+
+
+def test_image_adapter_forward():
+    a = ImageInputAdapter(image_shape=(28, 28, 1), num_frequency_bands=32)
+    p = a.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 28, 28, 1))
+    y = a.apply(p, x, policy=FP32)
+    assert y.shape == (2, 784, 131)
+    # first channel is the raw pixel values
+    np.testing.assert_allclose(np.asarray(y[:, :, 0]),
+                               np.asarray(x.reshape(2, 784)), atol=1e-6)
+
+
+def test_image_adapter_rejects_wrong_shape():
+    a = ImageInputAdapter(image_shape=(28, 28, 1), num_frequency_bands=4)
+    p = a.init(jax.random.key(0))
+    try:
+        a.apply(p, jnp.zeros((2, 32, 32, 1)), policy=FP32)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_text_adapter_embedding_scale_and_pos():
+    a = TextInputAdapter(vocab_size=100, max_seq_len=16,
+                         num_input_channels=64)
+    p = a.init(jax.random.key(0))
+    assert p["embed"].shape == (100, 64) and p["pos"].shape == (16, 64)
+    assert np.all(np.abs(p["embed"]) <= 0.1)
+    assert np.all(np.abs(p["pos"]) <= 0.5)
+    x = jnp.array([[1, 2, 3, 4]])
+    y = a.apply(p, x, policy=FP32)
+    assert y.shape == (1, 4, 64)
+    expected = np.asarray(p["embed"])[np.array([1, 2, 3, 4])] * 8.0 \
+        + np.asarray(p["pos"])[:4]
+    np.testing.assert_allclose(np.asarray(y[0]), expected, atol=1e-6)
+
+
+def test_classification_output_adapter_squeeze():
+    a = ClassificationOutputAdapter(num_classes=10)
+    assert a.output_shape == (1, 10)
+    p = a.init(jax.random.key(0))
+    y = a.apply(p, jnp.ones((2, 1, 10)), policy=FP32)
+    assert y.shape == (2, 10)
+
+
+def test_classification_output_adapter_multi_output():
+    a = ClassificationOutputAdapter(num_classes=3, num_outputs=5,
+                                    num_output_channels=8)
+    assert a.output_shape == (5, 8)
+    p = a.init(jax.random.key(0))
+    y = a.apply(p, jnp.ones((2, 5, 8)), policy=FP32)
+    assert y.shape == (2, 5, 3)
+
+
+def test_semantic_seg_output_adapter_applies_linear():
+    # The reference's forward is a no-op defect (SURVEY.md §2.6.3);
+    # ours projects to class logits.
+    a = SemanticSegOutputAdapter(num_classes=3, num_outputs=16,
+                                 num_output_channels=8)
+    p = a.init(jax.random.key(0))
+    y = a.apply(p, jnp.ones((2, 16, 8)), policy=FP32)
+    assert y.shape == (2, 16, 3)
+
+
+def test_text_output_adapter():
+    a = TextOutputAdapter(vocab_size=50, max_seq_len=12,
+                          num_output_channels=8)
+    assert a.output_shape == (12, 8)
+    p = a.init(jax.random.key(0))
+    y = a.apply(p, jnp.ones((2, 12, 8)), policy=FP32)
+    assert y.shape == (2, 12, 50)

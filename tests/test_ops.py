@@ -1,0 +1,158 @@
+"""Unit tests for the tensor core (perceiver_tpu.ops)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_tpu.ops import (
+    Policy,
+    linear_init,
+    linear_apply,
+    layer_norm_init,
+    layer_norm_apply,
+    mlp_init,
+    mlp_apply,
+    mha_init,
+    mha_apply,
+    cross_attention_init,
+    cross_attention_apply,
+    self_attention_init,
+    self_attention_apply,
+)
+
+FP32 = Policy.fp32()
+
+
+def test_linear_shapes_and_init_bounds():
+    p = linear_init(jax.random.key(0), 16, 32)
+    assert p["w"].shape == (16, 32) and p["b"].shape == (32,)
+    bound = 1 / np.sqrt(16)
+    assert np.all(np.abs(p["w"]) <= bound)
+    y = linear_apply(p, jnp.ones((2, 5, 16)), policy=FP32)
+    assert y.shape == (2, 5, 32)
+
+
+def test_layer_norm_matches_numpy():
+    p = layer_norm_init(8)
+    x = jax.random.normal(jax.random.key(1), (4, 8))
+    y = layer_norm_apply(p, x, policy=FP32)
+    xn = np.asarray(x)
+    expected = (xn - xn.mean(-1, keepdims=True)) / np.sqrt(
+        xn.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), expected, atol=1e-5)
+
+
+def test_mlp_hidden_width_equals_channels():
+    # Reference model.py:20-26 — no 4x expansion.
+    p = mlp_init(jax.random.key(0), 12)
+    assert p["fc1"]["w"].shape == (12, 12)
+    y = mlp_apply(p, jnp.ones((2, 3, 12)), policy=FP32)
+    assert y.shape == (2, 3, 12)
+
+
+def test_mha_output_shape_asymmetric_kv():
+    p = mha_init(jax.random.key(0), q_dim=32, num_heads=4, k_dim=131,
+                 v_dim=131)
+    q = jax.random.normal(jax.random.key(1), (2, 7, 32))
+    kv = jax.random.normal(jax.random.key(2), (2, 50, 131))
+    y = mha_apply(p, q, kv, kv, num_heads=4, policy=FP32)
+    assert y.shape == (2, 7, 32)
+
+
+def test_mha_key_padding_mask_blocks_positions():
+    """Masked kv positions must not influence the output."""
+    p = mha_init(jax.random.key(0), q_dim=16, num_heads=2)
+    q = jax.random.normal(jax.random.key(1), (1, 3, 16))
+    kv = jax.random.normal(jax.random.key(2), (1, 6, 16))
+    mask = jnp.array([[False, False, False, True, True, True]])
+
+    y1 = mha_apply(p, q, kv, kv, num_heads=2, key_padding_mask=mask,
+                   policy=FP32)
+    # Perturb the masked positions wildly; output must be unchanged.
+    kv2 = kv.at[:, 3:].set(100.0)
+    y2 = mha_apply(p, q, kv2, kv2, num_heads=2, key_padding_mask=mask,
+                   policy=FP32)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)
+    # And must differ from the unmasked result.
+    y3 = mha_apply(p, q, kv, kv, num_heads=2, policy=FP32)
+    assert not np.allclose(np.asarray(y1), np.asarray(y3), atol=1e-3)
+
+
+def test_mha_additive_and_boolean_attn_mask_agree():
+    p = mha_init(jax.random.key(0), q_dim=16, num_heads=2)
+    x = jax.random.normal(jax.random.key(1), (2, 5, 16))
+    bool_mask = jnp.triu(jnp.ones((5, 5), bool), k=1)
+    add_mask = jnp.where(bool_mask, -1e30, 0.0)
+    y1 = mha_apply(p, x, x, x, num_heads=2, attn_mask=bool_mask, policy=FP32)
+    y2 = mha_apply(p, x, x, x, num_heads=2, attn_mask=add_mask, policy=FP32)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-6)
+
+
+def test_mha_matches_torch_multihead_attention():
+    """Numerical parity with torch nn.MultiheadAttention (the op the
+    reference wraps, model.py:59-74), including asymmetric kdim/vdim
+    and key_padding_mask."""
+    torch = pytest.importorskip("torch")
+
+    q_dim, kv_dim, heads, lq, lk, b = 32, 48, 4, 5, 11, 3
+    tm = torch.nn.MultiheadAttention(embed_dim=q_dim, num_heads=heads,
+                                     kdim=kv_dim, vdim=kv_dim,
+                                     batch_first=True)
+    tm.eval()
+
+    params = {
+        "q": {"w": jnp.asarray(tm.q_proj_weight.detach().numpy().T),
+              "b": jnp.asarray(tm.in_proj_bias.detach().numpy()[:q_dim])},
+        "k": {"w": jnp.asarray(tm.k_proj_weight.detach().numpy().T),
+              "b": jnp.asarray(
+                  tm.in_proj_bias.detach().numpy()[q_dim:2 * q_dim])},
+        "v": {"w": jnp.asarray(tm.v_proj_weight.detach().numpy().T),
+              "b": jnp.asarray(
+                  tm.in_proj_bias.detach().numpy()[2 * q_dim:])},
+        "out": {"w": jnp.asarray(tm.out_proj.weight.detach().numpy().T),
+                "b": jnp.asarray(tm.out_proj.bias.detach().numpy())},
+    }
+
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((b, lq, q_dim), dtype=np.float32)
+    kv = rng.standard_normal((b, lk, kv_dim), dtype=np.float32)
+    pad = np.zeros((b, lk), dtype=bool)
+    pad[:, -3:] = True
+
+    with torch.no_grad():
+        expected, _ = tm(torch.from_numpy(q), torch.from_numpy(kv),
+                         torch.from_numpy(kv),
+                         key_padding_mask=torch.from_numpy(pad))
+
+    got = mha_apply(params, jnp.asarray(q), jnp.asarray(kv), jnp.asarray(kv),
+                    num_heads=heads, key_padding_mask=jnp.asarray(pad),
+                    policy=FP32)
+    np.testing.assert_allclose(np.asarray(got), expected.numpy(), atol=2e-5)
+
+
+def test_cross_attention_prenorm_and_shapes():
+    p = cross_attention_init(jax.random.key(0), num_q_channels=64,
+                             num_kv_channels=131, num_heads=4)
+    xq = jax.random.normal(jax.random.key(1), (2, 32, 64))
+    xkv = jax.random.normal(jax.random.key(2), (2, 784, 131))
+    y = cross_attention_apply(p, xq, xkv, num_heads=4, policy=FP32)
+    assert y.shape == (2, 32, 64)
+
+
+def test_self_attention_shapes():
+    p = self_attention_init(jax.random.key(0), num_channels=64, num_heads=4)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 64))
+    y = self_attention_apply(p, x, num_heads=4, policy=FP32)
+    assert y.shape == (2, 32, 64)
+
+
+def test_bf16_policy_close_to_fp32():
+    p = mha_init(jax.random.key(0), q_dim=32, num_heads=4)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 32))
+    y32 = mha_apply(p, x, x, x, num_heads=4, policy=FP32)
+    ybf = mha_apply(p, x, x, x, num_heads=4, policy=Policy.bf16())
+    assert ybf.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y32),
+                               np.asarray(ybf, dtype=np.float32),
+                               atol=0.1)

@@ -1,0 +1,149 @@
+"""Tokenizer tests, incl. parity with the shipped HF tokenizer JSON."""
+
+import json
+import os
+
+import pytest
+
+from perceiver_tpu.tokenizer import (
+    PAD_TOKEN_ID,
+    SPECIAL_TOKENS,
+    WordPieceTokenizer,
+    create_tokenizer,
+    train_tokenizer,
+)
+from perceiver_tpu.tokenizer.wordpiece import Replace
+
+SHIPPED = "/root/reference/.cache/imdb-tokenizer-10003.json"
+
+
+def test_special_token_ids():
+    # reference tokenizer.py:10-19
+    from perceiver_tpu.tokenizer import (PAD_TOKEN, UNK_TOKEN, MASK_TOKEN,
+                                         UNK_TOKEN_ID, MASK_TOKEN_ID)
+    assert (PAD_TOKEN, PAD_TOKEN_ID) == ("[PAD]", 0)
+    assert (UNK_TOKEN, UNK_TOKEN_ID) == ("[UNK]", 1)
+    assert (MASK_TOKEN, MASK_TOKEN_ID) == ("[MASK]", 2)
+    assert SPECIAL_TOKENS == ["[PAD]", "[UNK]", "[MASK]"]
+
+
+@pytest.mark.skipif(not os.path.exists(SHIPPED),
+                    reason="shipped tokenizer not present")
+class TestShippedTokenizerParity:
+    def setup_method(self):
+        self.tok = WordPieceTokenizer.from_file(SHIPPED)
+
+    def test_loads_vocab(self):
+        assert self.tok.get_vocab_size() == 10003
+        assert self.tok.token_to_id("[PAD]") == 0
+        assert self.tok.token_to_id("[UNK]") == 1
+        assert self.tok.token_to_id("[MASK]") == 2
+
+    def test_encode_known_words(self):
+        enc = self.tok.encode("This is a great movie!")
+        assert all(i != 1 for i in enc.ids)  # no UNK for common words
+        assert self.tok.decode(enc.ids) == "this is a great movie!"
+
+    def test_normalizer_chain_replace_br(self):
+        # IMDB passes Replace('<br />', ' ') (data/imdb.py:101)
+        enc1 = self.tok.encode("good<br />movie")
+        enc2 = self.tok.encode("good movie")
+        assert enc1.ids == enc2.ids
+
+    def test_normalizer_accents_and_case(self):
+        enc1 = self.tok.encode("Café CRÈME")
+        enc2 = self.tok.encode("cafe creme")
+        assert enc1.ids == enc2.ids
+
+    def test_wordpiece_continuation(self):
+        # unusual word must split into ## pieces, not UNK
+        enc = self.tok.encode("unbelievableness")
+        assert len(enc.tokens) > 1
+        assert any(t.startswith("##") for t in enc.tokens)
+        assert "".join(t.removeprefix("##") for t in enc.tokens) \
+            == "unbelievableness"
+
+    def test_padding_and_truncation(self):
+        self.tok.enable_padding(pad_id=0, pad_token="[PAD]")
+        self.tok.enable_truncation(8)
+        encs = self.tok.encode_batch(["a very long sentence that truncates "
+                                      "beyond eight tokens certainly",
+                                      "short"])
+        assert len(encs[0].ids) == 8 and len(encs[1].ids) == 8
+        assert encs[1].ids[-1] == 0
+        self.tok.no_padding()
+        self.tok.no_truncation()
+
+    def test_save_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "tok.json")
+        self.tok.save(p)
+        tok2 = WordPieceTokenizer.from_file(p)
+        assert tok2.get_vocab_size() == 10003
+        s = "An absolutely wonderful film <br /> with great acting."
+        assert tok2.encode(s).ids == self.tok.encode(s).ids
+
+    def test_json_model_section_matches_shipped(self, tmp_path):
+        p = str(tmp_path / "tok.json")
+        self.tok.save(p)
+        with open(SHIPPED) as f:
+            ref = json.load(f)
+        with open(p) as f:
+            ours = json.load(f)
+        assert ours["model"] == ref["model"]
+        assert ours["normalizer"] == ref["normalizer"]
+        assert ours["pre_tokenizer"] == ref["pre_tokenizer"]
+        assert ours["added_tokens"] == ref["added_tokens"]
+
+
+@pytest.mark.skipif(not os.path.exists(SHIPPED),
+                    reason="shipped tokenizer not present")
+def test_parity_with_hf_tokenizers_if_available():
+    """If the Rust HF library is importable, byte-level id parity."""
+    hf = pytest.importorskip("tokenizers")
+    ref = hf.Tokenizer.from_file(SHIPPED)
+    ours = WordPieceTokenizer.from_file(SHIPPED)
+    samples = [
+        "This movie was absolutely fantastic! I loved every minute.",
+        "Worst. Film. Ever. <br /><br />Don't waste your time...",
+        "Café touché — naïve résumé's crème brûlée!?",
+        "supercalifragilisticexpialidocious antidisestablishmentarianism",
+        "numbers 123 456,789 and $9.99 (50% off)",
+    ]
+    for s in samples:
+        ids = ref.encode(s).ids
+        assert ours.encode(s).ids == ids, s
+        assert ours.decode(ids) == ref.decode(ids), s
+
+
+@pytest.mark.skipif(not os.path.exists(SHIPPED),
+                    reason="shipped tokenizer not present")
+def test_special_tokens_matched_on_raw_text():
+    """'[MASK]' in a raw string must map to id 2, surviving the
+    lowercasing normalizer (HF added_tokens semantics; the reference's
+    predict_masked_samples path depends on it, utils.py:27)."""
+    tok = WordPieceTokenizer.from_file(SHIPPED)
+    enc = tok.encode("I watched this [MASK] yesterday")
+    assert 2 in enc.ids
+    assert "[MASK]" in enc.tokens
+    enc2 = tok.encode("[MASK][MASK] double")
+    assert enc2.ids[:2] == [2, 2]
+
+
+def test_trainer_learns_vocab_and_roundtrips():
+    corpus = ["the quick brown fox jumps over the lazy dog",
+              "the lazy dog sleeps", "quick quick fox"] * 5
+    tok = create_tokenizer()
+    train_tokenizer(tok, corpus, vocab_size=60)
+    assert tok.get_vocab_size() <= 60
+    assert tok.token_to_id("[PAD]") == 0
+    enc = tok.encode("the quick fox")
+    assert 1 not in enc.ids  # fully covered by learned vocab
+    assert tok.decode(enc.ids) == "the quick fox"
+
+
+def test_trainer_with_replace_normalizer():
+    corpus = ["hello<br />world"] * 3
+    tok = create_tokenizer(Replace("<br />", " "))
+    train_tokenizer(tok, corpus, vocab_size=40)
+    enc = tok.encode("hello<br />world")
+    assert tok.decode(enc.ids) == "hello world"
