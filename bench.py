@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Benchmark: IMDB-MLM training throughput on one TPU chip.
+
+Measures the BASELINE.md primary metric — tokens/sec/chip for MLM
+pretraining at seq_len=512 with the reference model config (64×64
+latents, 3 encoder layers, 6 self-attn layers/block, vocab 10003) —
+on a full jitted train step (forward + backward + AdamW update) in
+bf16. Prints ONE JSON line.
+
+``vs_baseline`` is null: the reference publishes no throughput numbers
+(BASELINE.json "published": {}).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from perceiver_tpu.ops.policy import Policy
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    seq_len, vocab = 512, 10003
+    batch_size = 64
+    task = MaskedLanguageModelTask(vocab_size=vocab, max_seq_len=seq_len)
+    model = task.build()
+    policy = Policy.bf16()
+
+    params = model.init(jax.random.key(0))
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(3, vocab, (batch_size, seq_len)),
+                      jnp.int32)
+    pad = jnp.zeros((batch_size, seq_len), bool)
+
+    @jax.jit
+    def train_step(params, opt_state, ids, pad, rng):
+        def loss_fn(p):
+            loss, _ = task.loss_and_metrics(
+                model, p, {"input_ids": ids, "pad_mask": pad},
+                rng=rng, deterministic=False, policy=policy)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.key(1)
+    # warmup/compile
+    params, opt_state, loss = train_step(params, opt_state, ids, pad, key)
+    jax.block_until_ready(loss)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        key = jax.random.fold_in(key, i)
+        params, opt_state, loss = train_step(params, opt_state, ids, pad,
+                                             key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = n_steps / dt
+    tokens_per_sec = steps_per_sec * batch_size * seq_len
+
+    print(json.dumps({
+        "metric": "imdb_mlm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "detail": {
+            "seq_len": seq_len,
+            "batch_size": batch_size,
+            "steps_per_sec": round(steps_per_sec, 3),
+            "precision": "bf16",
+            "loss": float(loss),
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

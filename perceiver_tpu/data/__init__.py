@@ -1,0 +1,5 @@
+"""Host-side data modules (NumPy pipelines feeding device batches)."""
+
+from perceiver_tpu.data.core import ArrayDataset, BatchIterator  # noqa: F401
+from perceiver_tpu.data.mnist import MNISTDataModule  # noqa: F401
+from perceiver_tpu.data.imdb import IMDBDataModule, Collator  # noqa: F401

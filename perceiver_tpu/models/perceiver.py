@@ -351,7 +351,13 @@ class PerceiverMLM:
         """Returns ``(logits, labels)``; ``labels`` is None when
         ``masking=False`` (inference path, reference utils.py:30)."""
         l = x_input.shape[1]
-        k_mask, k_enc, k_dec = jax.random.split(_rng_or_dummy(rng, deterministic), 3)
+        if masking and rng is None:
+            # a silent constant key would mask the same positions in
+            # every batch — val_loss would be computed on one fixed,
+            # position-correlated 15% subset
+            raise ValueError("masking=True requires an explicit `rng` key")
+        k_mask, k_enc, k_dec = jax.random.split(
+            _rng_or_dummy(rng, deterministic), 3)
 
         if masking:
             x_masked, labels = self.masking.apply(k_mask, x_input, pad_mask)

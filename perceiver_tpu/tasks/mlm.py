@@ -1,0 +1,101 @@
+"""Masked-language-model task (reference ``LitMaskedLanguageModel``,
+``lightning.py:174-256``): TextInputAdapter/TextOutputAdapter around
+PerceiverMLM, CE over (B, M, V) logits vs −100-ignored labels.
+
+The reference's version cannot construct its model — it calls
+``TextMasking(vocab_size)`` without the required token-id args
+(``lightning.py:213``, SURVEY.md §2.6.2). Here the masking config is
+explicit, defaulting to the framework tokenizer's special-token layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from perceiver_tpu.adapters import TextInputAdapter, TextOutputAdapter
+from perceiver_tpu.models import (
+    PerceiverDecoder,
+    PerceiverEncoder,
+    PerceiverMLM,
+    TextMasking,
+)
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+from perceiver_tpu.tasks.base import IGNORE, TaskConfig, cross_entropy
+from perceiver_tpu.tokenizer import (
+    MASK_TOKEN_ID,
+    SPECIAL_TOKENS,
+    UNK_TOKEN_ID,
+)
+
+
+def create_encoder(cfg: TaskConfig, vocab_size: int,
+                   max_seq_len: int) -> PerceiverEncoder:
+    """Shared MLM/text-classifier encoder builder (lightning.py:186-200)."""
+    input_adapter = TextInputAdapter(
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+        num_input_channels=cfg.num_latent_channels)
+    return PerceiverEncoder(
+        input_adapter=input_adapter,
+        latent_shape=cfg.latent_shape,
+        num_layers=cfg.num_encoder_layers,
+        num_cross_attention_heads=cfg.num_encoder_cross_attention_heads,
+        num_self_attention_heads=cfg.num_encoder_self_attention_heads,
+        num_self_attention_layers_per_block=(
+            cfg.num_encoder_self_attention_layers_per_block),
+        dropout=cfg.dropout)
+
+
+@dataclasses.dataclass(frozen=True)
+class MaskedLanguageModelTask(TaskConfig):
+    vocab_size: int = 10003
+    max_seq_len: int = 512
+    masked_samples: Optional[List[str]] = None
+    num_predictions: int = 3
+    mask_p: float = 0.15
+
+    def build(self) -> PerceiverMLM:
+        encoder = create_encoder(self, self.vocab_size, self.max_seq_len)
+        output_adapter = TextOutputAdapter(
+            vocab_size=self.vocab_size, max_seq_len=self.max_seq_len,
+            num_output_channels=self.num_latent_channels)
+        decoder = PerceiverDecoder(
+            output_adapter=output_adapter,
+            latent_shape=self.latent_shape,
+            num_cross_attention_heads=self.num_decoder_cross_attention_heads,
+            dropout=self.dropout)
+        masking = TextMasking(
+            vocab_size=self.vocab_size, unk_token_id=UNK_TOKEN_ID,
+            mask_token_id=MASK_TOKEN_ID,
+            num_special_tokens=len(SPECIAL_TOKENS), mask_p=self.mask_p)
+        return PerceiverMLM(encoder, decoder, masking)
+
+    def on_validation_epoch_end(self, trainer, state):
+        """Log top-k predictions for the configured masked samples to
+        the TB text plugin (reference ``lightning.py:241-256``)."""
+        if not self.masked_samples:
+            return
+        dm = trainer.datamodule
+        if getattr(dm, "collator", None) is None:
+            return
+        from perceiver_tpu.utils.predict import predict_masked_samples
+        samples = [s.replace("<MASK>", "[MASK]")
+                   for s in self.masked_samples]
+        predictions = predict_masked_samples(
+            samples, dm.collator.encode, dm.tokenizer, trainer.model,
+            state.params, num_predictions=self.num_predictions,
+            policy=trainer.policy)
+        text = "\n\n".join("  \n".join([s] + ps)
+                           for s, ps in zip(samples, predictions))
+        trainer.writer.add_text("sample predictions", text,
+                                trainer.global_step)
+
+    def loss_and_metrics(self, model, params, batch, *, rng=None,
+                         deterministic: bool = True,
+                         policy: Policy = DEFAULT_POLICY):
+        logits, labels = model.apply(
+            params, batch["input_ids"], batch["pad_mask"], rng=rng,
+            deterministic=deterministic, policy=policy)
+        loss = cross_entropy(logits, labels, batch.get("valid"),
+                             ignore_index=IGNORE)
+        return loss, {"loss": loss}

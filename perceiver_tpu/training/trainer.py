@@ -1,0 +1,321 @@
+"""Training orchestration: the Lightning-Trainer-equivalent loop.
+
+Preserves the operative flag surface of ``scripts/trainer.yaml``
+(SURVEY §2.3): max_epochs/max_steps, fast_dev_run, overfit_batches,
+limit_{train,val,test}_batches, gradient_clip_val,
+accumulate_grad_batches, log_every_n_steps, num_sanity_val_steps,
+check_val_every_n_epoch, default_root_dir, enable_checkpointing,
+resume_from_checkpoint, detect_anomaly, profiler, precision — each
+implemented with the JAX-native mechanism (debug_nans, jax.profiler,
+dtype policy) rather than Lightning plumbing.
+
+The step path is one jitted, donated function over the whole
+``TrainState`` pytree; when a ``jax.sharding.Mesh`` is supplied the
+state is replicated and batches are sharded over the ``data`` axis, so
+the same trainer drives one chip or a pod slice (GSPMD inserts the
+gradient all-reduce — the NCCL-DDP equivalent, SURVEY §2.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import optax
+
+from perceiver_tpu.ops.policy import Policy
+from perceiver_tpu.training.checkpoint import CheckpointHook
+from perceiver_tpu.training.optim import create_optimizer
+from perceiver_tpu.training.state import TrainState
+from perceiver_tpu.utils.tb import SummaryWriter
+
+_UNLIMITED_EPOCHS = 1000  # Lightning's default cap for max_epochs=-1
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    max_epochs: int = -1
+    max_steps: int = -1
+    precision: Any = "bf16"  # 32 | "bf16" (trainer.yaml:49 default 32)
+    gradient_clip_val: float = 0.0
+    accumulate_grad_batches: int = 1
+    log_every_n_steps: int = 50
+    num_sanity_val_steps: int = 2
+    check_val_every_n_epoch: int = 1
+    fast_dev_run: bool = False
+    overfit_batches: int = 0
+    limit_train_batches: Optional[int] = None
+    limit_val_batches: Optional[int] = None
+    limit_test_batches: Optional[int] = None
+    default_root_dir: str = "logs"
+    experiment: str = "default"
+    enable_checkpointing: bool = True
+    checkpoint_monitor: str = "val_loss"
+    save_top_k: int = 1
+    resume_from_checkpoint: Optional[str] = None
+    detect_anomaly: bool = False
+    profiler: Optional[str] = None
+    seed: int = 42
+    # informational parity flags (mesh decides actual placement)
+    accelerator: str = "auto"
+    devices: Any = "auto"
+
+    def policy(self) -> Policy:
+        if str(self.precision) in ("32", "fp32", "32-true"):
+            return Policy.fp32()
+        return Policy.bf16()
+
+
+def _version_dir(root: str, experiment: str) -> str:
+    """logs/{experiment}/version_N — the reference's TB layout."""
+    base = os.path.join(root, experiment)
+    os.makedirs(base, exist_ok=True)
+    versions = [int(d.split("_")[1]) for d in os.listdir(base)
+                if d.startswith("version_") and d.split("_")[1].isdigit()]
+    return os.path.join(base, f"version_{max(versions, default=-1) + 1}")
+
+
+class Trainer:
+    def __init__(self, task, datamodule, config: TrainerConfig = None,
+                 optimizer_init: Optional[dict] = None,
+                 scheduler_init: Optional[dict] = None,
+                 mesh: Optional[jax.sharding.Mesh] = None):
+        self.task = task
+        self.datamodule = datamodule
+        self.config = config or TrainerConfig()
+        self.optimizer_init = optimizer_init
+        self.scheduler_init = scheduler_init
+        self.mesh = mesh
+
+        self.model = task.build()
+        self.policy = self.config.policy()
+        self.global_step = 0
+        self.current_epoch = 0
+
+        self.log_dir = _version_dir(self.config.default_root_dir,
+                                    self.config.experiment)
+        self.writer: Optional[SummaryWriter] = None
+        self._ckpt: Optional[CheckpointHook] = None
+        self._train_step = None
+        self._eval_step = None
+
+    # --- setup ---------------------------------------------------------------
+
+    def _hparams(self) -> dict:
+        return {
+            "task": dataclasses.asdict(self.task),
+            "trainer": dataclasses.asdict(self.config),
+            "optimizer_init": self.optimizer_init,
+            "scheduler_init": self.scheduler_init,
+        }
+
+    def _build_state(self) -> TrainState:
+        cfg = self.config
+        rng = jax.random.key(cfg.seed)
+        init_rng, state_rng = jax.random.split(rng)
+        params = self.model.init(init_rng)
+        if hasattr(self.task, "restore_pretrained"):
+            params = self.task.restore_pretrained(params)
+
+        labels = None
+        if hasattr(self.task, "frozen_param_labels"):
+            labels = self.task.frozen_param_labels(params)
+        self.tx, self.lr_fn = create_optimizer(
+            self.optimizer_init, self.scheduler_init,
+            max_steps=cfg.max_steps if cfg.max_steps > 0 else None,
+            gradient_clip_val=cfg.gradient_clip_val,
+            accumulate_grad_batches=cfg.accumulate_grad_batches,
+            param_labels=labels)
+        opt_state = self.tx.init(params)
+        state = TrainState.create(params, opt_state, state_rng)
+
+        if self.mesh is not None:
+            replicated = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec())
+            state = jax.device_put(state, replicated)
+        return state
+
+    def _shard_batch(self, batch: Dict[str, np.ndarray]):
+        if self.mesh is None:
+            return batch
+        sharding = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec("data"))
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+
+    def _make_steps(self):
+        task, model, policy = self.task, self.model, self.policy
+
+        def train_step(state: TrainState, batch):
+            rng, step_rng = jax.random.split(state.rng)
+
+            def loss_fn(params):
+                return task.loss_and_metrics(
+                    model, params, batch, rng=step_rng,
+                    deterministic=False, policy=policy)
+
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (_, metrics), grads = grad_fn(state.params)
+            updates, opt_state = self.tx.update(grads, state.opt_state,
+                                                state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(params=params, opt_state=opt_state,
+                                   rng=rng, step=state.step + 1)
+            return new_state, metrics
+
+        def eval_step(state: TrainState, batch, rng):
+            # deterministic=True switches dropout off; the rng still
+            # drives stochastic model inputs (MLM masking) and is folded
+            # per batch index by _run_eval so every eval batch gets an
+            # independent mask layout
+            _, metrics = task.loss_and_metrics(
+                model, state.params, batch, rng=rng, deterministic=True,
+                policy=policy)
+            # weighted by valid count so padded final batches are exact
+            n = batch["valid"].sum() if "valid" in batch \
+                else next(iter(batch.values())).shape[0]
+            return metrics, n
+
+        self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._eval_step = jax.jit(eval_step)
+
+    # --- loops ---------------------------------------------------------------
+
+    def _run_eval(self, loader, limit: Optional[int], state: TrainState,
+                  prefix: str) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        count = 0.0
+        eval_key = jax.random.key(self.config.seed + 1)
+        for i, batch in enumerate(loader):
+            if limit is not None and i >= limit:
+                break
+            metrics, n = self._eval_step(state, self._shard_batch(batch),
+                                         jax.random.fold_in(eval_key, i))
+            n = float(n)
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * n
+            count += n
+        if count == 0:
+            return {}
+        return {f"{prefix}_{k}": v / count for k, v in totals.items()}
+
+    def fit(self) -> TrainState:
+        cfg = self.config
+        if cfg.detect_anomaly:
+            jax.config.update("jax_debug_nans", True)
+
+        self.datamodule.prepare_data()
+        self.datamodule.setup()
+        self.writer = SummaryWriter(self.log_dir)
+        if cfg.enable_checkpointing:
+            self._ckpt = CheckpointHook(
+                os.path.join(self.log_dir, "checkpoints"),
+                max_to_keep=cfg.save_top_k,
+                monitor=cfg.checkpoint_monitor,
+                hparams=self._hparams())
+
+        state = self._build_state()
+        self._make_steps()
+
+        if cfg.resume_from_checkpoint:
+            hook = CheckpointHook(cfg.resume_from_checkpoint,
+                                  monitor=cfg.checkpoint_monitor)
+            restored = hook.restore_latest(state)
+            if restored is not None:
+                state = restored
+                self.global_step = int(state.step)
+
+        max_epochs = (1 if cfg.fast_dev_run
+                      else cfg.max_epochs if cfg.max_epochs > 0
+                      else _UNLIMITED_EPOCHS)
+        limit_train = (1 if cfg.fast_dev_run
+                       else cfg.overfit_batches or cfg.limit_train_batches)
+        limit_val = 1 if cfg.fast_dev_run else cfg.limit_val_batches
+
+        train_loader = self.datamodule.train_dataloader()
+        if cfg.overfit_batches:
+            # Lightning semantics: overfit repeats the SAME batches every
+            # epoch, so shuffling must be disabled
+            train_loader.shuffle = False
+
+        # sanity validation (trainer.yaml:53)
+        if cfg.num_sanity_val_steps and not cfg.fast_dev_run:
+            self._run_eval(self.datamodule.val_dataloader(),
+                           cfg.num_sanity_val_steps, state, "sanity")
+
+        if cfg.profiler:
+            jax.profiler.start_trace(os.path.join(self.log_dir, "profile"))
+
+        stop = False
+        t0, samples_since = time.time(), 0
+        for epoch in range(max_epochs):
+            self.current_epoch = epoch
+            train_loader.set_epoch(epoch)
+            for i, batch in enumerate(train_loader):
+                if limit_train is not None and i >= limit_train:
+                    break
+                batch_size = len(batch["valid"])
+                state, metrics = self._train_step(
+                    state, self._shard_batch(batch))
+                self.global_step += 1
+                samples_since += batch_size
+
+                if self.global_step % cfg.log_every_n_steps == 0 \
+                        or cfg.fast_dev_run:
+                    dt = time.time() - t0
+                    throughput = samples_since / max(dt, 1e-9)
+                    for k, v in metrics.items():
+                        self.writer.add_scalar(f"train_{k}", float(v),
+                                               self.global_step)
+                    # MultiSteps advances the schedule once per
+                    # accumulation window, not per micro-step
+                    opt_step = (self.global_step
+                                // max(cfg.accumulate_grad_batches, 1))
+                    self.writer.add_scalar(
+                        "lr", float(self.lr_fn(opt_step)),
+                        self.global_step)
+                    self.writer.add_scalar("samples_per_sec", throughput,
+                                           self.global_step)
+                    t0, samples_since = time.time(), 0
+
+                if cfg.max_steps > 0 and self.global_step >= cfg.max_steps:
+                    stop = True
+                    break
+
+            if epoch % cfg.check_val_every_n_epoch == 0 or stop:
+                val_metrics = self._run_eval(
+                    self.datamodule.val_dataloader(), limit_val, state,
+                    "val")
+                for k, v in val_metrics.items():
+                    self.writer.add_scalar(k, v, self.global_step)
+                if hasattr(self.task, "on_validation_epoch_end"):
+                    self.task.on_validation_epoch_end(self, state)
+                if self._ckpt is not None and val_metrics:
+                    self._ckpt.save(self.global_step, state, val_metrics)
+            if stop:
+                break
+
+        if cfg.profiler:
+            jax.profiler.stop_trace()
+        if self._ckpt is not None:
+            self._ckpt.wait()
+        self.final_state = state
+        return state
+
+    def validate(self, state: TrainState) -> Dict[str, float]:
+        self.datamodule.setup()
+        if self._eval_step is None:
+            self._make_steps()
+        m = self._run_eval(self.datamodule.val_dataloader(),
+                           self.config.limit_val_batches, state, "val")
+        return m
+
+    def test(self, state: TrainState) -> Dict[str, float]:
+        self.datamodule.setup()
+        if self._eval_step is None:
+            self._make_steps()
+        return self._run_eval(self.datamodule.test_dataloader(),
+                              self.config.limit_test_batches, state, "test")

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Image-classification CLI (reference ``scripts/img_clf.py``).
+
+Example (mirrors README.md:114-122):
+
+    python scripts/img_clf.py fit \\
+      --data=MNISTDataModule --data.batch_size=128 \\
+      --model.num_latents=32 --model.num_latent_channels=128 \\
+      --trainer.max_epochs=20 --experiment=img_clf
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from perceiver_tpu.data import MNISTDataModule  # noqa: E402
+from perceiver_tpu.tasks import ImageClassifierTask  # noqa: E402
+from perceiver_tpu.utils.config import CLI, Link  # noqa: E402
+
+TRAINER_YAML = os.path.join(os.path.dirname(__file__), "trainer.yaml")
+
+
+def main(args=None, run=True):
+    return CLI(
+        ImageClassifierTask,
+        datamodules={"MNISTDataModule": MNISTDataModule},
+        default_datamodule="MNISTDataModule",
+        default_config_files=[TRAINER_YAML],
+        defaults={  # reference img_clf.py:14-22
+            "experiment": "img_clf",
+            "model.num_latents": 32,
+            "model.num_latent_channels": 128,
+            "model.num_encoder_layers": 3,
+            "model.num_encoder_self_attention_layers_per_block": 3,
+            "model.num_decoder_cross_attention_heads": 1,
+            "model.num_frequency_bands": 32,
+        },
+        links=[  # reference img_clf.py:12-13
+            Link("data.num_classes", "model.num_classes",
+                 apply_on="instantiate"),
+            Link("data.image_shape", "model.image_shape",
+                 apply_on="instantiate"),
+        ],
+        description=__doc__,
+        run=run,
+        args=args,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Masked-language-model pretraining CLI (reference ``scripts/mlm.py``).
+
+Example (mirrors README.md:34-44):
+
+    python scripts/mlm.py fit \\
+      --data=IMDBDataModule --data.max_seq_len=512 --data.batch_size=64 \\
+      --optimizer.init_args.lr=0.002 --trainer.max_steps=50000 \\
+      --experiment=mlm
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from perceiver_tpu.data import IMDBDataModule  # noqa: E402
+from perceiver_tpu.tasks import MaskedLanguageModelTask  # noqa: E402
+from perceiver_tpu.utils.config import CLI, Link  # noqa: E402
+
+TRAINER_YAML = os.path.join(os.path.dirname(__file__), "trainer.yaml")
+
+# reference mlm.py:19-29 default masked samples
+DEFAULT_MASKED_SAMPLES = [
+    "I have watched this <MASK> and it was awesome",
+    "I have <MASK> this movie and <MASK> did not like it",
+]
+
+
+def main(args=None, run=True):
+    return CLI(
+        MaskedLanguageModelTask,
+        datamodules={"IMDBDataModule": IMDBDataModule},
+        default_datamodule="IMDBDataModule",
+        default_config_files=[TRAINER_YAML],
+        defaults={
+            "experiment": "mlm",
+            "model.masked_samples": DEFAULT_MASKED_SAMPLES,
+            "model.num_predictions": 3,
+        },
+        links=[
+            # reference mlm.py:14-18: OneCycle total_steps ← max_steps,
+            # max_lr ← optimizer lr; model vocab/seq ← datamodule
+            Link("trainer.max_steps",
+                 "lr_scheduler.init_args.total_steps"),
+            Link("optimizer.init_args.lr", "lr_scheduler.init_args.max_lr"),
+            Link("data.vocab_size", "model.vocab_size",
+                 apply_on="instantiate"),
+            Link("data.max_seq_len", "model.max_seq_len",
+                 apply_on="instantiate"),
+        ],
+        description=__doc__,
+        run=run,
+        args=args,
+    )
+
+
+if __name__ == "__main__":
+    main()

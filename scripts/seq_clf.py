@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Sentiment-classification CLI (reference ``scripts/seq_clf.py``),
+with MLM transfer learning and encoder freezing.
+
+Two-phase recipe (mirrors README.md:77-107):
+
+    python scripts/seq_clf.py fit \\
+      --model.mlm_ckpt=logs/mlm/version_0/checkpoints \\
+      --model.freeze_encoder=true --trainer.max_epochs=15 \\
+      --experiment=seq_clf
+
+    python scripts/seq_clf.py fit \\
+      --model.clf_ckpt=logs/seq_clf/version_0/checkpoints \\
+      --optimizer.init_args.lr=1e-4 --trainer.max_epochs=5
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from perceiver_tpu.data import IMDBDataModule  # noqa: E402
+from perceiver_tpu.tasks import TextClassifierTask  # noqa: E402
+from perceiver_tpu.utils.config import CLI, Link  # noqa: E402
+
+TRAINER_YAML = os.path.join(os.path.dirname(__file__), "trainer.yaml")
+
+
+def main(args=None, run=True):
+    return CLI(
+        TextClassifierTask,
+        datamodules={"IMDBDataModule": IMDBDataModule},
+        default_datamodule="IMDBDataModule",
+        default_config_files=[TRAINER_YAML],
+        defaults={  # reference seq_clf.py:13-22
+            "experiment": "seq_clf",
+            "model.num_classes": 2,
+            "model.num_decoder_cross_attention_heads": 1,
+        },
+        links=[
+            Link("data.vocab_size", "model.vocab_size",
+                 apply_on="instantiate"),
+            Link("data.max_seq_len", "model.max_seq_len",
+                 apply_on="instantiate"),
+        ],
+        description=__doc__,
+        run=run,
+        args=args,
+    )
+
+
+if __name__ == "__main__":
+    main()

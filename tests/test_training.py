@@ -1,0 +1,191 @@
+"""End-to-end training-slice tests (SURVEY §4 plan items d, e)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from perceiver_tpu.data import IMDBDataModule, MNISTDataModule
+from perceiver_tpu.tasks import (
+    ImageClassifierTask,
+    MaskedLanguageModelTask,
+    TextClassifierTask,
+)
+from perceiver_tpu.training import Trainer, TrainerConfig
+
+ADAMW = {"class_path": "AdamW", "init_args": {"lr": 1e-3}}
+
+
+def small_image_task():
+    return ImageClassifierTask(
+        image_shape=(28, 28, 1), num_classes=10, num_frequency_bands=8,
+        num_latents=16, num_latent_channels=32, num_encoder_layers=2,
+        num_encoder_self_attention_layers_per_block=2,
+        num_decoder_cross_attention_heads=1)
+
+
+def test_fast_dev_run(tmp_path):
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=64, synthetic_test_size=32)
+    trainer = Trainer(small_image_task(), dm,
+                      TrainerConfig(fast_dev_run=True,
+                                    default_root_dir=str(tmp_path / "logs"),
+                                    enable_checkpointing=False),
+                      optimizer_init=ADAMW)
+    state = trainer.fit()
+    assert trainer.global_step == 1
+    assert np.isfinite(float(state.step))
+
+
+def test_overfit_batches_loss_decreases(tmp_path):
+    """The overfit sanity from trainer.yaml:29 — tiny subset, loss must
+    fall, proving the full vertical (data→model→loss→optimizer)."""
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=32,
+                         synthetic_train_size=64, synthetic_test_size=32)
+    trainer = Trainer(small_image_task(), dm,
+                      TrainerConfig(max_epochs=100, overfit_batches=1,
+                                    log_every_n_steps=25,
+                                    num_sanity_val_steps=0,
+                                    default_root_dir=str(tmp_path / "logs"),
+                                    enable_checkpointing=False,
+                                    precision=32),
+                      optimizer_init={"class_path": "AdamW",
+                                      "init_args": {"lr": 3e-3}})
+    dm.setup()
+    batch = next(iter(dm.train_dataloader()))
+    state = trainer.fit()
+    # loss on the overfit batch must have dropped well below init (~2.3)
+    metrics, _ = trainer._eval_step(state, batch, jax.random.key(0))
+    assert float(metrics["loss"]) < 1.0
+    assert float(metrics["acc"]) > 0.8
+
+
+def test_checkpoint_save_restore_resume(tmp_path):
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=64, synthetic_test_size=32)
+    cfg = TrainerConfig(max_steps=3, max_epochs=2, num_sanity_val_steps=0,
+                        default_root_dir=str(tmp_path / "logs"),
+                        save_top_k=2, log_every_n_steps=1)
+    trainer = Trainer(small_image_task(), dm, cfg, optimizer_init=ADAMW)
+    state = trainer.fit()
+    ckpt_dir = os.path.join(trainer.log_dir, "checkpoints")
+    assert os.path.isdir(ckpt_dir)
+    assert os.path.exists(os.path.join(ckpt_dir, "hparams.json"))
+
+    # resume into a fresh trainer
+    cfg2 = TrainerConfig(max_steps=5, max_epochs=4, num_sanity_val_steps=0,
+                         default_root_dir=str(tmp_path / "logs2"),
+                         resume_from_checkpoint=ckpt_dir,
+                         enable_checkpointing=False, log_every_n_steps=1)
+    trainer2 = Trainer(small_image_task(), dm, cfg2, optimizer_init=ADAMW)
+    state2 = trainer2.fit()
+    assert int(state2.step) == 5  # resumed from 3, ran 2 more
+    # restored params actually came from the checkpoint
+    l1 = np.asarray(state.params["encoder"]["latent"])
+    # state was donated during trainer2 steps; compare via fresh restore
+    from perceiver_tpu.training.checkpoint import restore_params
+    restored = restore_params(ckpt_dir)
+    np.testing.assert_allclose(np.asarray(restored["encoder"]["latent"]),
+                               l1)
+
+
+def test_tb_event_files_written(tmp_path):
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=32, synthetic_test_size=16)
+    trainer = Trainer(small_image_task(), dm,
+                      TrainerConfig(fast_dev_run=True,
+                                    default_root_dir=str(tmp_path / "logs"),
+                                    enable_checkpointing=False),
+                      optimizer_init=ADAMW)
+    trainer.fit()
+    files = os.listdir(trainer.log_dir)
+    assert any(f.startswith("events.out.tfevents") for f in files)
+    # version_N layout like the reference (logs/{exp}/version_0)
+    assert "/default/version_0" in trainer.log_dir.replace(os.sep, "/")
+
+
+def test_mlm_task_end_to_end(tmp_path):
+    dm = IMDBDataModule(data_dir=str(tmp_path / "cache"), vocab_size=200,
+                        max_seq_len=64, batch_size=8,
+                        synthetic_train_size=64, synthetic_test_size=16)
+    task = MaskedLanguageModelTask(
+        vocab_size=200, max_seq_len=64, num_latents=8,
+        num_latent_channels=32, num_encoder_layers=2,
+        num_encoder_self_attention_layers_per_block=2,
+        masked_samples=["i {} this film".format("<MASK>")])
+    trainer = Trainer(task, dm,
+                      TrainerConfig(max_steps=2, max_epochs=1,
+                                    num_sanity_val_steps=0,
+                                    log_every_n_steps=1,
+                                    default_root_dir=str(tmp_path / "logs"),
+                                    enable_checkpointing=False),
+                      optimizer_init=ADAMW,
+                      scheduler_init={"class_path": "OneCycleLR",
+                                      "init_args": {"max_lr": 1e-3,
+                                                    "total_steps": 2}})
+    state = trainer.fit()
+    assert int(state.step) == 2
+    # vocab_size from datamodule side: tokenizer trained+cached
+    assert os.path.exists(dm.tokenizer_path)
+
+
+def test_text_classifier_transfer_and_freeze(tmp_path):
+    """Transfer recipe (lightning.py:144-152): train MLM briefly, save,
+    restore encoder into classifier with freeze_encoder=True; frozen
+    encoder params must not move, decoder params must."""
+    from perceiver_tpu.training.checkpoint import save_params
+
+    dm = IMDBDataModule(data_dir=str(tmp_path / "cache"), vocab_size=150,
+                        max_seq_len=32, batch_size=8,
+                        synthetic_train_size=32, synthetic_test_size=16)
+    mlm_task = MaskedLanguageModelTask(
+        vocab_size=150, max_seq_len=32, num_latents=8,
+        num_latent_channels=16, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1)
+    mlm_model = mlm_task.build()
+    mlm_params = mlm_model.init(jax.random.key(0))
+    ckpt = str(tmp_path / "mlm_ckpt")
+    save_params(ckpt, mlm_params)
+
+    clf_task = TextClassifierTask(
+        num_classes=2, vocab_size=150, max_seq_len=32, num_latents=8,
+        num_latent_channels=16, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        freeze_encoder=True, mlm_ckpt=ckpt)
+    trainer = Trainer(clf_task, dm,
+                      TrainerConfig(max_steps=3, max_epochs=2,
+                                    num_sanity_val_steps=0,
+                                    log_every_n_steps=1,
+                                    default_root_dir=str(tmp_path / "logs"),
+                                    enable_checkpointing=False),
+                      optimizer_init=ADAMW)
+    state = trainer.fit()
+
+    enc0 = np.asarray(mlm_params["encoder"]["latent"])
+    enc1 = np.asarray(state.params["encoder"]["latent"])
+    np.testing.assert_allclose(enc0, enc1)  # frozen AND restored
+    dec_moved = not np.allclose(
+        np.asarray(state.params["decoder"]["query"]),
+        np.asarray(clf_task.build().init(jax.random.key(42))["decoder"]
+                   ["query"]))
+    assert dec_moved
+
+
+def test_trainer_on_virtual_mesh(tmp_path):
+    """Data-parallel fit over the 8-device virtual CPU mesh."""
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = jax.sharding.Mesh(np.array(devices), ("data",))
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=64, synthetic_test_size=32)
+    trainer = Trainer(small_image_task(), dm,
+                      TrainerConfig(max_steps=2, max_epochs=1,
+                                    num_sanity_val_steps=0,
+                                    log_every_n_steps=1,
+                                    default_root_dir=str(tmp_path / "logs"),
+                                    enable_checkpointing=False),
+                      optimizer_init=ADAMW, mesh=mesh)
+    state = trainer.fit()
+    assert int(state.step) == 2
