@@ -1,0 +1,312 @@
+// WordPiece tokenizer native core.
+//
+// The reference delegates tokenization to the Rust HF `tokenizers`
+// library (reference perceiver/tokenizer.py:3-7); this is the
+// framework's C++ equivalent for the two hot paths:
+//
+//   wp_encode_words — greedy longest-match WordPiece over a vocab hash
+//     (byte-wise longest match; vocab entries are valid UTF-8, so
+//     mid-codepoint splits can never match and char-boundary semantics
+//     are preserved).
+//   wp_train — likelihood-scored pair-merge training
+//     (score = freq(pair) / (freq(a) * freq(b))) with incremental
+//     pair/symbol-frequency bookkeeping, so training the IMDB corpus
+//     to a 10k vocab is minutes of C++, not hours of Python.
+//
+// Normalization (NFD/lowercase/strip-accents) stays in Python: CPython's
+// unicodedata is already a C extension and it is not on the hot path.
+//
+// Exposed over a plain C ABI for ctypes (no pybind11 in this image).
+// Tie-breaking matches the pure-Python trainer exactly (score desc,
+// then lexicographically smaller pair), so native and fallback engines
+// produce identical vocabularies.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+    size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+        return std::hash<int64_t>()(
+            (static_cast<int64_t>(p.first) << 32) ^
+            static_cast<uint32_t>(p.second));
+    }
+};
+
+struct Vocab {
+    std::unordered_map<std::string, int32_t> token_to_id;
+    size_t max_token_bytes = 0;
+};
+
+size_t utf8_len(const std::string& s) {
+    size_t n = 0;
+    for (unsigned char c : s)
+        if ((c & 0xC0) != 0x80) ++n;
+    return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wp_vocab_create(const char** tokens, int32_t n) {
+    auto* v = new Vocab();
+    for (int32_t i = 0; i < n; ++i) {
+        std::string t(tokens[i]);
+        v->max_token_bytes = std::max(v->max_token_bytes, t.size());
+        v->token_to_id.emplace(std::move(t), i);
+    }
+    return v;
+}
+
+void wp_vocab_free(void* v) { delete static_cast<Vocab*>(v); }
+
+// Encode one pre-tokenized word. Appends piece ids to out (capacity cap);
+// returns the number of ids written, or -1 if cap was insufficient.
+int32_t wp_encode_word(void* vp, const char* word, int32_t unk_id,
+                       int32_t max_chars, const char* prefix,
+                       int32_t* out, int32_t cap) {
+    const Vocab& v = *static_cast<Vocab*>(vp);
+    std::string w(word);
+    if (utf8_len(w) > static_cast<size_t>(max_chars)) {
+        if (cap < 1) return -1;
+        out[0] = unk_id;
+        return 1;
+    }
+    const std::string pref(prefix);
+    int32_t count = 0;
+    size_t start = 0;
+    std::string candidate;
+    while (start < w.size()) {
+        size_t end = w.size();
+        int32_t piece = -1;
+        size_t piece_end = 0;
+        while (start < end) {
+            candidate.clear();
+            if (start > 0) candidate = pref;
+            candidate.append(w, start, end - start);
+            auto it = v.token_to_id.find(candidate);
+            if (it != v.token_to_id.end()) {
+                piece = it->second;
+                piece_end = end;
+                break;
+            }
+            --end;
+        }
+        if (piece < 0) {
+            if (cap < 1) return -1;
+            out[0] = unk_id;
+            return 1;
+        }
+        if (count >= cap) return -1;
+        out[count++] = piece;
+        start = piece_end;
+    }
+    return count;
+}
+
+// Encode a batch of pre-tokenized words, '\n'-joined, in one call —
+// per-word FFI round-trips cost more than the WordPiece matching itself.
+// Returns the number of ids written, or -1 if cap was insufficient.
+int32_t wp_encode_words(void* vp, const char* words, int32_t unk_id,
+                        int32_t max_chars, const char* prefix,
+                        int32_t* out, int32_t cap) {
+    int32_t total = 0;
+    const char* p = words;
+    std::string word;
+    while (*p) {
+        const char* nl = strchr(p, '\n');
+        size_t len = nl ? static_cast<size_t>(nl - p) : strlen(p);
+        word.assign(p, len);
+        int32_t n = wp_encode_word(vp, word.c_str(), unk_id, max_chars,
+                                   prefix, out + total, cap - total);
+        if (n < 0) return -1;
+        total += n;
+        if (!nl) break;
+        p = nl + 1;
+    }
+    return total;
+}
+
+// ---------------------------------------------------------------------------
+// Trainer
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Trainer {
+    std::vector<std::string> id_to_sym;          // symbol strings
+    std::unordered_map<std::string, int32_t> sym_to_id;
+    std::vector<std::vector<int32_t>> words;     // word -> symbol ids
+    std::vector<int64_t> counts;                 // word -> corpus count
+    std::vector<int64_t> sym_freq;               // symbol -> occurrences
+    using Pair = std::pair<int32_t, int32_t>;
+    std::unordered_map<Pair, int64_t, PairHash> pair_freq;
+    std::unordered_map<Pair, std::unordered_set<int32_t>, PairHash>
+        pair_words;
+
+    int32_t intern(const std::string& s) {
+        auto it = sym_to_id.find(s);
+        if (it != sym_to_id.end()) return it->second;
+        int32_t id = static_cast<int32_t>(id_to_sym.size());
+        id_to_sym.push_back(s);
+        sym_to_id.emplace(s, id);
+        sym_freq.push_back(0);
+        return id;
+    }
+
+    void add_pairs_of(int32_t wi) {
+        const auto& syms = words[wi];
+        int64_t c = counts[wi];
+        for (size_t j = 0; j + 1 < syms.size(); ++j) {
+            Pair p{syms[j], syms[j + 1]};
+            pair_freq[p] += c;
+            pair_words[p].insert(wi);
+        }
+    }
+
+    void remove_pairs_of(int32_t wi) {
+        const auto& syms = words[wi];
+        int64_t c = counts[wi];
+        for (size_t j = 0; j + 1 < syms.size(); ++j) {
+            Pair p{syms[j], syms[j + 1]};
+            auto it = pair_freq.find(p);
+            if (it != pair_freq.end()) {
+                it->second -= c;
+                if (it->second <= 0) {
+                    pair_freq.erase(it);
+                    pair_words.erase(p);
+                }
+            }
+        }
+    }
+};
+
+}  // namespace
+
+// Train from unique words + counts. Returns a malloc'd buffer of
+// '\n'-joined vocab tokens in id order (caller frees with wp_free).
+char* wp_train(const char** word_strs, const int64_t* word_counts,
+               int32_t n_words, const char** specials, int32_t n_specials,
+               const char* prefix, int32_t vocab_size, int64_t min_freq) {
+    Trainer tr;
+    const std::string pref(prefix);
+
+    // vocab under construction: specials first, then alphabet, then merges
+    std::vector<std::string> vocab;
+    std::unordered_set<std::string> vocab_set;
+    auto add_vocab = [&](const std::string& t) {
+        if (vocab_set.insert(t).second) vocab.push_back(t);
+    };
+    for (int32_t i = 0; i < n_specials; ++i) add_vocab(specials[i]);
+
+    // split words into initial symbols (first char plain, rest ##'d)
+    std::map<std::string, size_t> alphabet;  // ordered like sorted(set)
+    tr.words.resize(n_words);
+    tr.counts.assign(word_counts, word_counts + n_words);
+    for (int32_t wi = 0; wi < n_words; ++wi) {
+        const std::string w(word_strs[wi]);
+        std::vector<std::string> chars;
+        size_t i = 0;
+        while (i < w.size()) {
+            size_t j = i + 1;
+            while (j < w.size() && (static_cast<unsigned char>(w[j]) & 0xC0)
+                       == 0x80)
+                ++j;
+            chars.push_back(w.substr(i, j - i));
+            i = j;
+        }
+        auto& syms = tr.words[wi];
+        for (size_t k = 0; k < chars.size(); ++k) {
+            std::string s = k == 0 ? chars[k] : pref + chars[k];
+            alphabet[s] = 1;
+            int32_t id = tr.intern(s);
+            syms.push_back(id);
+            tr.sym_freq[id] += tr.counts[wi];
+        }
+    }
+    for (const auto& kv : alphabet) add_vocab(kv.first);
+    for (int32_t wi = 0; wi < n_words; ++wi) tr.add_pairs_of(wi);
+
+    const int64_t effective_min = min_freq > 1 ? min_freq : 1;
+    while (static_cast<int32_t>(vocab.size()) < vocab_size &&
+           !tr.pair_freq.empty()) {
+        // argmax score; tie → lexicographically smaller (a, b)
+        Trainer::Pair best{-1, -1};
+        double best_score = -1.0;
+        for (const auto& kv : tr.pair_freq) {
+            if (kv.second < effective_min) continue;
+            double score = static_cast<double>(kv.second) /
+                (static_cast<double>(tr.sym_freq[kv.first.first]) *
+                 static_cast<double>(tr.sym_freq[kv.first.second]));
+            if (score > best_score) {
+                best = kv.first;
+                best_score = score;
+            } else if (score == best_score && best.first >= 0) {
+                const std::string& a1 = tr.id_to_sym[kv.first.first];
+                const std::string& b1 = tr.id_to_sym[kv.first.second];
+                const std::string& a0 = tr.id_to_sym[best.first];
+                const std::string& b0 = tr.id_to_sym[best.second];
+                if (a1 < a0 || (a1 == a0 && b1 < b0)) best = kv.first;
+            }
+        }
+        if (best.first < 0) break;
+
+        const std::string& a = tr.id_to_sym[best.first];
+        const std::string& b = tr.id_to_sym[best.second];
+        std::string merged = a + (b.rfind(pref, 0) == 0
+                                  ? b.substr(pref.size()) : b);
+        int32_t merged_id = tr.intern(merged);
+        add_vocab(merged);
+
+        // rewrite only the words containing the merged pair
+        auto affected_it = tr.pair_words.find(best);
+        if (affected_it == tr.pair_words.end()) break;
+        std::vector<int32_t> affected(affected_it->second.begin(),
+                                      affected_it->second.end());
+        for (int32_t wi : affected) {
+            tr.remove_pairs_of(wi);
+            auto& syms = tr.words[wi];
+            std::vector<int32_t> out;
+            out.reserve(syms.size());
+            size_t j = 0;
+            while (j < syms.size()) {
+                if (j + 1 < syms.size() && syms[j] == best.first &&
+                    syms[j + 1] == best.second) {
+                    out.push_back(merged_id);
+                    tr.sym_freq[best.first] -= tr.counts[wi];
+                    tr.sym_freq[best.second] -= tr.counts[wi];
+                    tr.sym_freq[merged_id] += tr.counts[wi];
+                    j += 2;
+                } else {
+                    out.push_back(syms[j]);
+                    ++j;
+                }
+            }
+            syms.swap(out);
+            tr.add_pairs_of(wi);
+        }
+    }
+
+    size_t total = 0;
+    for (const auto& t : vocab) total += t.size() + 1;
+    char* buf = static_cast<char*>(malloc(total + 1));
+    char* p = buf;
+    for (const auto& t : vocab) {
+        memcpy(p, t.data(), t.size());
+        p += t.size();
+        *p++ = '\n';
+    }
+    *p = '\0';
+    return buf;
+}
+
+void wp_free(char* p) { free(p); }
+
+}  // extern "C"

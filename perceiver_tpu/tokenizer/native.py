@@ -1,0 +1,133 @@
+"""ctypes bridge to the C++ WordPiece core (csrc/wordpiece.cpp).
+
+Builds the shared library on first use (g++ -O2, cached beside the
+source) — no pybind11 in this image, so the ABI is plain C. Falls back
+cleanly: callers catch ImportError/OSError and use the pure-Python
+engine, which produces identical results (asserted by tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections import Counter
+from typing import Iterable, List
+
+_SRC = os.path.join(os.path.dirname(__file__), "csrc", "wordpiece.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "csrc", "libwordpiece.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     _SRC, "-o", _LIB],
+                    check=True, capture_output=True)
+            except subprocess.CalledProcessError as e:
+                # normalize to OSError so callers' documented fallback
+                # (except (ImportError, OSError)) catches compile failure
+                raise OSError(
+                    f"native tokenizer build failed: "
+                    f"{e.stderr.decode(errors='replace')[:500]}") from e
+        lib = ctypes.CDLL(_LIB)
+        lib.wp_vocab_create.restype = ctypes.c_void_p
+        lib.wp_vocab_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32]
+        lib.wp_vocab_free.argtypes = [ctypes.c_void_p]
+        lib.wp_encode_words.restype = ctypes.c_int32
+        lib.wp_encode_words.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.wp_train.restype = ctypes.c_void_p  # manual free
+        lib.wp_train.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64]
+        lib.wp_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeVocab:
+    """Vocab handle for repeated fast encodes."""
+
+    def __init__(self, tokenizer):
+        lib = _load()
+        self._lib = lib
+        ordered = sorted(tokenizer.vocab.items(), key=lambda kv: kv[1])
+        self._id_map = [i for _, i in ordered]  # dense idx -> real id
+        toks = (ctypes.c_char_p * len(ordered))(
+            *[t.encode("utf-8") for t, _ in ordered])
+        self._handle = lib.wp_vocab_create(toks, len(ordered))
+        self._unk_dense = next(
+            j for j, (t, _) in enumerate(ordered)
+            if t == tokenizer.unk_token)
+        self._prefix = tokenizer.prefix.encode("utf-8")
+        self._max_chars = tokenizer.max_input_chars_per_word
+        self._buf = (ctypes.c_int32 * 4096)()
+
+    def encode_words(self, words: List[str]) -> List[int]:
+        """One FFI round-trip for a whole pre-tokenized word list."""
+        payload = "\n".join(words).encode("utf-8")
+        buf = self._buf
+        while True:
+            n = self._lib.wp_encode_words(
+                self._handle, payload, self._unk_dense, self._max_chars,
+                self._prefix, buf, len(buf))
+            if n >= 0:
+                break
+            buf = (ctypes.c_int32 * (len(buf) * 4))()
+            self._buf = buf
+        id_map = self._id_map
+        return [id_map[buf[i]] for i in range(n)]
+
+    def __del__(self):
+        try:
+            self._lib.wp_vocab_free(self._handle)
+        except Exception:
+            pass
+
+
+def count_words(tokenizer, data: Iterable[str]) -> Counter:
+    """Shared corpus word-counting (normalize → pre-tokenize → count);
+    both the native and pure-Python trainers feed from this so their
+    inputs can never diverge."""
+    counts: Counter = Counter()
+    for text in data:
+        for w in tokenizer.pre_tokenize(tokenizer.normalize(text)):
+            counts[w] += 1
+    return counts
+
+
+def native_train(tokenizer, data: Iterable[str], vocab_size: int,
+                 special_tokens: List[str], min_frequency: int) -> dict:
+    """Count words host-side, train merges in C++; returns vocab dict."""
+    lib = _load()
+    items = sorted(count_words(tokenizer, data).items())  # deterministic
+    words = (ctypes.c_char_p * len(items))(
+        *[w.encode("utf-8") for w, _ in items])
+    cts = (ctypes.c_int64 * len(items))(*[c for _, c in items])
+    specials = (ctypes.c_char_p * len(special_tokens))(
+        *[s.encode("utf-8") for s in special_tokens])
+    ptr = lib.wp_train(words, cts, len(items), specials,
+                       len(special_tokens),
+                       tokenizer.prefix.encode("utf-8"),
+                       vocab_size, min_frequency)
+    try:
+        raw = ctypes.string_at(ptr).decode("utf-8")
+    finally:
+        lib.wp_free(ptr)
+    tokens = [t for t in raw.split("\n") if t]
+    return {t: i for i, t in enumerate(tokens)}

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import re
 import unicodedata
 from typing import Iterable, List, Optional, Sequence
@@ -40,6 +41,9 @@ from perceiver_tpu.tokenizer.vocab import (
 )
 
 _WHITESPACE_RE = re.compile(r"\w+|[^\w\s]+")
+
+# env escape hatch: PERCEIVER_TPU_NO_NATIVE=1 pins the pure-Python engine
+_USE_NATIVE = os.environ.get("PERCEIVER_TPU_NO_NATIVE") != "1"
 
 # HF WordPiece decoder cleanup=true replacements, applied PER TOKEN
 # (after the leading space is attached) — not on the joined string;
@@ -141,6 +145,8 @@ class WordPieceTokenizer:
         self.max_input_chars_per_word = max_input_chars_per_word
         self._padding = None  # (pad_id, pad_token) when enabled
         self._truncation = None  # max_length when enabled
+        self._native = None  # lazily built C++ vocab handle
+        self._native_failed = not _USE_NATIVE
 
     # -- vocabulary access (HF surface) --
 
@@ -179,6 +185,21 @@ class WordPieceTokenizer:
     def pre_tokenize(text: str) -> List[str]:
         return _WHITESPACE_RE.findall(text)
 
+    def _invalidate_native(self):
+        self._native = None
+
+    def _native_vocab(self):
+        if self._native_failed:
+            return None
+        if self._native is None:
+            try:
+                from perceiver_tpu.tokenizer.native import NativeVocab
+                self._native = NativeVocab(self)
+            except Exception:
+                self._native_failed = True
+                return None
+        return self._native
+
     def _encode_word(self, word: str) -> List[str]:
         if len(word) > self.max_input_chars_per_word:
             return [self.unk_token]
@@ -211,21 +232,29 @@ class WordPieceTokenizer:
         # input before the normalizer runs — HF added_tokens semantics;
         # this is what lets '[MASK]' in a raw string survive lowercasing
         # (the reference's predict_masked_samples path, utils.py:27).
-        tokens: List[str] = []
+        ids: List[int] = []
         pattern = self._added_token_re()
         segments = ([text] if pattern is None
                     else self._split_on_added(text, pattern))
         for seg in segments:
             if seg in self.vocab and pattern is not None \
                     and pattern.fullmatch(seg):
-                tokens.append(seg)
+                ids.append(self.vocab[seg])
                 continue
-            for word in self.pre_tokenize(self.normalize(seg)):
-                tokens.extend(self._encode_word(word))
+            words = self.pre_tokenize(self.normalize(seg))
+            nv = self._native_vocab()
+            if nv is not None:
+                # words never contain whitespace (whitespace pre-
+                # tokenization), so the '\n'-joined batch ABI is safe
+                ids.extend(nv.encode_words(words))
+            else:
+                for word in words:
+                    ids.extend(self.vocab[t]
+                               for t in self._encode_word(word))
         if self._truncation is not None:
-            tokens = tokens[:self._truncation]
-        ids = [self.vocab[t] for t in tokens]
-        return Encoding(ids=ids, tokens=tokens)
+            ids = ids[:self._truncation]
+        return Encoding(ids=ids,
+                        tokens=[self.ids_to_tokens[i] for i in ids])
 
     @staticmethod
     def _split_on_added(text: str, pattern: re.Pattern) -> List[str]:
@@ -356,16 +385,15 @@ class WordPieceTrainer:
             vocab = self._train_py(tokenizer, data)
         tokenizer.vocab = vocab
         tokenizer.ids_to_tokens = {i: t for t, i in vocab.items()}
+        tokenizer._invalidate_native()
 
     def _train_py(self, tokenizer: WordPieceTokenizer,
                   data: Iterable[str]) -> dict:
         from collections import Counter
+        from perceiver_tpu.tokenizer.native import count_words
         prefix = tokenizer.prefix
 
-        word_counts: Counter = Counter()
-        for text in data:
-            for w in tokenizer.pre_tokenize(tokenizer.normalize(text)):
-                word_counts[w] += 1
+        word_counts: Counter = count_words(tokenizer, data)
 
         vocab: dict = {}
         for t in self.special_tokens:

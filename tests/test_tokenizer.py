@@ -129,6 +129,42 @@ def test_special_tokens_matched_on_raw_text():
     assert enc2.ids[:2] == [2, 2]
 
 
+@pytest.mark.skipif(not os.path.exists(SHIPPED),
+                    reason="shipped tokenizer not present")
+def test_native_encode_matches_python_engine():
+    """The C++ core and the pure-Python engine must agree id-for-id."""
+    tok_native = WordPieceTokenizer.from_file(SHIPPED)
+    tok_py = WordPieceTokenizer.from_file(SHIPPED)
+    tok_py._native_failed = True  # pin the Python path
+    samples = [
+        "An absolutely wonderful film with great acting.",
+        "Café touché — naïve résumé!? [MASK] unbelievableness",
+        "x" * 150,  # exceeds max_input_chars_per_word → [UNK]
+        "edge-case:semi;colons and CJK 電影 characters",
+    ]
+    for s in samples:
+        assert tok_native.encode(s).ids == tok_py.encode(s).ids, s
+    if tok_native._native is None:
+        pytest.skip("native library unavailable (g++ missing?)")
+
+
+def test_native_trainer_matches_python_trainer():
+    from perceiver_tpu.tokenizer.wordpiece import WordPieceTrainer
+    try:
+        from perceiver_tpu.tokenizer.native import native_train
+    except (ImportError, OSError):
+        pytest.skip("native library unavailable")
+    corpus = ["the quick brown fox jumps over the lazy dog",
+              "the lazy dog sleeps deeply",
+              "quick quick fox runs far"] * 7
+    tok = create_tokenizer()
+    trainer = WordPieceTrainer(vocab_size=90)
+    v_native = native_train(tok, corpus, 90,
+                            list(trainer.special_tokens), 0)
+    v_py = trainer._train_py(tok, corpus)
+    assert v_native == v_py
+
+
 def test_trainer_learns_vocab_and_roundtrips():
     corpus = ["the quick brown fox jumps over the lazy dog",
               "the lazy dog sleeps", "quick quick fox"] * 5
