@@ -85,14 +85,15 @@ def cross_attention_layer_apply(params, x_q, x_kv, *, num_heads,
                                 key_padding_mask=None, attn_mask=None,
                                 dropout_rate=0.0, rng=None,
                                 deterministic=True,
-                                policy: Policy = DEFAULT_POLICY):
+                                policy: Policy = DEFAULT_POLICY,
+                                impl=None, kv_chunk_size=1024):
     """Residual(CrossAttention) then Residual(mlp) (model.py:29-33)."""
     k_attn, k_r1, k_r2 = jax.random.split(_rng_or_dummy(rng, deterministic), 3)
     y = cross_attention_apply(
         params["attn"], x_q, x_kv, num_heads=num_heads,
         key_padding_mask=key_padding_mask, attn_mask=attn_mask,
         dropout_rate=dropout_rate, rng=k_attn, deterministic=deterministic,
-        policy=policy)
+        policy=policy, impl=impl, kv_chunk_size=kv_chunk_size)
     x = x_q + dropout(y, dropout_rate, rng=k_r1, deterministic=deterministic)
     y = mlp_apply(params["mlp"], x, policy=policy)
     return x + dropout(y, dropout_rate, rng=k_r2, deterministic=deterministic)
@@ -168,6 +169,12 @@ class PerceiverEncoder:
     num_self_attention_layers_per_block: int = 2
     dropout: float = 0.0
     widening_factor: int = 1
+    # Cross-attention kernel for the latent ← input step, the long-kv
+    # hot op: None/"einsum", "chunked" (lax.scan online softmax), or
+    # "flash" (fused Pallas TPU kernel). Self-attention over the small
+    # latent array always uses the einsum path.
+    attention_impl: Optional[str] = None
+    kv_chunk_size: int = 1024
 
     def _layer_init(self, key):
         kc, ks = jax.random.split(key)
@@ -201,7 +208,8 @@ class PerceiverEncoder:
             num_heads=self.num_cross_attention_heads,
             key_padding_mask=pad_mask, attn_mask=attn_mask,
             dropout_rate=self.dropout, rng=k_cross,
-            deterministic=deterministic, policy=policy)
+            deterministic=deterministic, policy=policy,
+            impl=self.attention_impl, kv_chunk_size=self.kv_chunk_size)
         return self_attention_block_apply(
             params["selfs"], latent,
             num_heads=self.num_self_attention_heads,
@@ -253,6 +261,12 @@ class PerceiverDecoder:
     # each other. Needed for the 262k-query segmentation config where
     # the full (B, K, N) attention-weight tensor would blow HBM.
     query_chunk_size: Optional[int] = None
+    # Attention kernel for the output-query ← latent cross-attention
+    # (see PerceiverEncoder.attention_impl). "flash" blocks over the
+    # query axis in-kernel, an alternative to query_chunk_size for the
+    # 262k-query config.
+    attention_impl: Optional[str] = None
+    kv_chunk_size: int = 1024
 
     def init(self, key):
         k_out, k_query, k_cross = jax.random.split(key, 3)
@@ -287,7 +301,8 @@ class PerceiverDecoder:
                 params["cross"], q, x,
                 num_heads=self.num_cross_attention_heads,
                 dropout_rate=self.dropout, rng=k,
-                deterministic=deterministic, policy=policy)
+                deterministic=deterministic, policy=policy,
+                impl=self.attention_impl, kv_chunk_size=self.kv_chunk_size)
 
         num_q = query.shape[1]
         cs = self.query_chunk_size

@@ -12,3 +12,7 @@ from perceiver_tpu.ops.attention import (  # noqa: F401
     self_attention_init,
     self_attention_apply,
 )
+# chunked_attention / flash_attention are NOT re-exported here:
+# the former would shadow its own submodule on the package namespace,
+# and the latter would eagerly import jax.experimental.pallas for
+# einsum-only users. Import them from their submodules.

@@ -72,19 +72,56 @@ def _split_heads(x, num_heads: int):
 def mha_apply(params, q, k, v, *, num_heads: int,
               key_padding_mask=None, attn_mask=None,
               dropout_rate: float = 0.0, rng=None, deterministic: bool = True,
-              policy: Policy = DEFAULT_POLICY):
+              policy: Policy = DEFAULT_POLICY, impl: Optional[str] = None,
+              kv_chunk_size: int = 1024):
     """Scaled dot-product multi-head attention.
 
     q: (B, Lq, q_dim); k: (B, Lk, k_dim); v: (B, Lk, v_dim).
     key_padding_mask: (B, Lk) bool, True at padding.
     attn_mask: (Lq, Lk) or (B, Lq, Lk); bool (True = masked) or additive.
+    impl: None/"einsum" (materialized weights, supports dropout and
+    attn_mask), "chunked" (blockwise lax.scan, O(Lq·chunk) memory), or
+    "flash" (fused Pallas TPU kernel; interpreter mode off-TPU).
     Returns (B, Lq, q_dim).
     """
+    if impl not in (None, "einsum", "chunked", "flash"):
+        raise ValueError(
+            f"unknown attention impl {impl!r}; expected None, 'einsum', "
+            "'chunked', or 'flash'")
+    if impl in ("chunked", "flash"):
+        if attn_mask is not None:
+            raise NotImplementedError(
+                f"impl={impl!r} supports key_padding_mask only, "
+                "not attn_mask")
+        if dropout_rate > 0.0 and not deterministic:
+            raise NotImplementedError(
+                f"impl={impl!r} does not support attention-weight "
+                "dropout; use the einsum impl")
+
     qh = _split_heads(linear_apply(params["q"], q, policy=policy), num_heads)
     kh = _split_heads(linear_apply(params["k"], k, policy=policy), num_heads)
     vh = _split_heads(linear_apply(params["v"], v, policy=policy), num_heads)
 
     head_dim = qh.shape[-1]
+    if impl in ("chunked", "flash"):
+        import perceiver_tpu.ops.chunked_attention as _ca
+        bias = (_ca.pad_mask_to_bias(key_padding_mask)
+                if key_padding_mask is not None else None)
+        # (B, L, H, D) → (B, H, L, D)
+        qt, kt, vt = (x.swapaxes(1, 2) for x in (qh, kh, vh))
+        scale = 1.0 / (head_dim ** 0.5)
+        if impl == "chunked":
+            out = _ca.chunked_attention(qt, kt, vt, bias=bias, scale=scale,
+                                        chunk_size=kv_chunk_size)
+        else:
+            import perceiver_tpu.ops.pallas_attention as _pa
+            out = _pa.flash_attention(qt, kt, vt, bias=bias, scale=scale,
+                                      block_k=kv_chunk_size)
+        out = out.swapaxes(1, 2)
+        b, lq = out.shape[0], out.shape[1]
+        out = out.reshape(b, lq, num_heads * head_dim)
+        return linear_apply(params["out"], out, policy=policy)
+
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, policy.norm_dtype))
     logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
                         preferred_element_type=policy.norm_dtype)
@@ -132,14 +169,17 @@ def cross_attention_apply(params, x_q, x_kv, *, num_heads: int,
                           key_padding_mask=None, attn_mask=None,
                           dropout_rate: float = 0.0, rng=None,
                           deterministic: bool = True,
-                          policy: Policy = DEFAULT_POLICY):
+                          policy: Policy = DEFAULT_POLICY,
+                          impl: Optional[str] = None,
+                          kv_chunk_size: int = 1024):
     """Pre-norm on q AND kv, then MHA (reference model.py:97-99)."""
     xq = layer_norm_apply(params["norm_q"], x_q, policy=policy)
     xkv = layer_norm_apply(params["norm_kv"], x_kv, policy=policy)
     return mha_apply(params["mha"], xq, xkv, xkv, num_heads=num_heads,
                      key_padding_mask=key_padding_mask, attn_mask=attn_mask,
                      dropout_rate=dropout_rate, rng=rng,
-                     deterministic=deterministic, policy=policy)
+                     deterministic=deterministic, policy=policy,
+                     impl=impl, kv_chunk_size=kv_chunk_size)
 
 
 def self_attention_init(key, num_channels: int, num_heads: int,
@@ -154,10 +194,13 @@ def self_attention_apply(params, x, *, num_heads: int,
                          key_padding_mask=None, attn_mask=None,
                          dropout_rate: float = 0.0, rng=None,
                          deterministic: bool = True,
-                         policy: Policy = DEFAULT_POLICY):
+                         policy: Policy = DEFAULT_POLICY,
+                         impl: Optional[str] = None,
+                         kv_chunk_size: int = 1024):
     """Pre-norm then MHA with q = k = v (reference model.py:110-116)."""
     xn = layer_norm_apply(params["norm"], x, policy=policy)
     return mha_apply(params["mha"], xn, xn, xn, num_heads=num_heads,
                      key_padding_mask=key_padding_mask, attn_mask=attn_mask,
                      dropout_rate=dropout_rate, rng=rng,
-                     deterministic=deterministic, policy=policy)
+                     deterministic=deterministic, policy=policy,
+                     impl=impl, kv_chunk_size=kv_chunk_size)

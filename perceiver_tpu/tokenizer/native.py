@@ -28,17 +28,28 @@ def _load() -> ctypes.CDLL:
             return _lib
         if (not os.path.exists(_LIB)
                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            # Build to a process-unique temp path and rename into place:
+            # rename is atomic, so concurrent processes (dataloader
+            # workers on a cold cache) never dlopen a half-written ELF.
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     _SRC, "-o", _LIB],
+                     _SRC, "-o", tmp],
                     check=True, capture_output=True)
+                os.replace(tmp, _LIB)
             except subprocess.CalledProcessError as e:
                 # normalize to OSError so callers' documented fallback
                 # (except (ImportError, OSError)) catches compile failure
                 raise OSError(
                     f"native tokenizer build failed: "
                     f"{e.stderr.decode(errors='replace')[:500]}") from e
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
         lib = ctypes.CDLL(_LIB)
         lib.wp_vocab_create.restype = ctypes.c_void_p
         lib.wp_vocab_create.argtypes = [
@@ -76,22 +87,27 @@ class NativeVocab:
             if t == tokenizer.unk_token)
         self._prefix = tokenizer.prefix.encode("utf-8")
         self._max_chars = tokenizer.max_input_chars_per_word
+        # ctypes releases the GIL during the C call, so the shared
+        # result buffer (and its grow path) must be guarded for
+        # concurrent encode() on one tokenizer instance.
+        self._buf_lock = threading.Lock()
         self._buf = (ctypes.c_int32 * 4096)()
 
     def encode_words(self, words: List[str]) -> List[int]:
         """One FFI round-trip for a whole pre-tokenized word list."""
         payload = "\n".join(words).encode("utf-8")
-        buf = self._buf
-        while True:
-            n = self._lib.wp_encode_words(
-                self._handle, payload, self._unk_dense, self._max_chars,
-                self._prefix, buf, len(buf))
-            if n >= 0:
-                break
-            buf = (ctypes.c_int32 * (len(buf) * 4))()
-            self._buf = buf
-        id_map = self._id_map
-        return [id_map[buf[i]] for i in range(n)]
+        with self._buf_lock:
+            buf = self._buf
+            while True:
+                n = self._lib.wp_encode_words(
+                    self._handle, payload, self._unk_dense, self._max_chars,
+                    self._prefix, buf, len(buf))
+                if n >= 0:
+                    break
+                buf = (ctypes.c_int32 * (len(buf) * 4))()
+                self._buf = buf
+            id_map = self._id_map
+            return [id_map[buf[i]] for i in range(n)]
 
     def __del__(self):
         try:

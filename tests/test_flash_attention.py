@@ -1,0 +1,168 @@
+"""Chunked and Pallas flash attention vs. the reference einsum path.
+
+The Pallas kernel runs in interpreter mode on the CPU test backend —
+the identical kernel body that compiles on TPU (SURVEY.md §4 plan (c)).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_tpu.ops import mha_init, mha_apply
+from perceiver_tpu.ops.chunked_attention import (
+    chunked_attention,
+    pad_mask_to_bias,
+)
+from perceiver_tpu.ops.pallas_attention import flash_attention
+from perceiver_tpu.ops.policy import Policy
+
+
+def _reference_attention(q, k, v, bias=None, scale=None):
+    """Materialized-softmax attention on (B, H, L, D) arrays."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias[:, None, None, :]
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
+
+
+def _qkv(key, b=2, h=2, lq=16, lk=100, d=24):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (b, h, lq, d)),
+            jax.random.normal(kk, (b, h, lk, d)),
+            jax.random.normal(kv, (b, h, lk, d)))
+
+
+class TestChunked:
+    def test_matches_reference(self):
+        q, k, v = _qkv(jax.random.key(0))
+        out = chunked_attention(q, k, v, chunk_size=32)
+        np.testing.assert_allclose(out, _reference_attention(q, k, v),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_with_padding_mask(self):
+        q, k, v = _qkv(jax.random.key(1))
+        pad = jnp.arange(100)[None, :] >= jnp.array([70, 100])[:, None]
+        bias = pad_mask_to_bias(pad)
+        out = chunked_attention(q, k, v, bias=bias, chunk_size=17)
+        ref = _reference_attention(q, k, v, bias=bias)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_gradients_match(self):
+        q, k, v = _qkv(jax.random.key(2), lk=64)
+
+        def loss_chunked(q, k, v):
+            return chunked_attention(q, k, v, chunk_size=16).sum()
+
+        def loss_ref(q, k, v):
+            return _reference_attention(q, k, v).sum()
+
+        g1 = jax.grad(loss_chunked, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+class TestFlash:
+    def test_matches_reference(self):
+        q, k, v = _qkv(jax.random.key(3))
+        out = flash_attention(q, k, v, block_q=8, block_k=64)
+        np.testing.assert_allclose(out, _reference_attention(q, k, v),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_with_padding_mask(self):
+        q, k, v = _qkv(jax.random.key(4))
+        pad = jnp.arange(100)[None, :] >= jnp.array([70, 100])[:, None]
+        bias = pad_mask_to_bias(pad)
+        out = flash_attention(q, k, v, bias=bias, block_q=8, block_k=32)
+        ref = _reference_attention(q, k, v, bias=bias)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_non_divisible_shapes(self):
+        # Lq, Lk, D all off the tile grid → wrapper pads and slices.
+        q, k, v = _qkv(jax.random.key(5), lq=13, lk=77, d=20)
+        out = flash_attention(q, k, v, block_q=8, block_k=32)
+        np.testing.assert_allclose(out, _reference_attention(q, k, v),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_gradients_match(self):
+        q, k, v = _qkv(jax.random.key(6), lk=48)
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, block_q=8, block_k=16).sum()
+
+        def loss_ref(q, k, v):
+            return _reference_attention(q, k, v).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_under_jit(self):
+        q, k, v = _qkv(jax.random.key(7))
+        out = jax.jit(lambda *a: flash_attention(*a, block_q=8,
+                                                 block_k=64))(q, k, v)
+        np.testing.assert_allclose(out, _reference_attention(q, k, v),
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestMhaImpls:
+    """All three impls agree through the full projected MHA op."""
+
+    @pytest.mark.parametrize("impl", ["chunked", "flash"])
+    def test_impl_matches_einsum(self, impl):
+        key = jax.random.key(8)
+        params = mha_init(key, q_dim=32, num_heads=4, k_dim=48, v_dim=48)
+        policy = Policy.fp32()
+        q = jax.random.normal(jax.random.key(9), (2, 10, 32))
+        kv = jax.random.normal(jax.random.key(10), (2, 50, 48))
+        pad = jnp.arange(50)[None, :] >= jnp.array([35, 50])[:, None]
+        ref = mha_apply(params, q, kv, kv, num_heads=4,
+                        key_padding_mask=pad, policy=policy)
+        out = mha_apply(params, q, kv, kv, num_heads=4,
+                        key_padding_mask=pad, policy=policy,
+                        impl=impl, kv_chunk_size=16)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_attn_mask_rejected(self):
+        params = mha_init(jax.random.key(0), q_dim=16, num_heads=2)
+        x = jnp.zeros((1, 4, 16))
+        mask = jnp.zeros((4, 4), bool)
+        with pytest.raises(NotImplementedError):
+            mha_apply(params, x, x, x, num_heads=2, attn_mask=mask,
+                      impl="chunked")
+
+    def test_dropout_rejected(self):
+        params = mha_init(jax.random.key(0), q_dim=16, num_heads=2)
+        x = jnp.zeros((1, 4, 16))
+        with pytest.raises(NotImplementedError):
+            mha_apply(params, x, x, x, num_heads=2, dropout_rate=0.1,
+                      deterministic=False, rng=jax.random.key(1),
+                      impl="flash")
+
+
+class TestQueryChunking:
+    def test_q_chunked_matches_reference(self):
+        q, k, v = _qkv(jax.random.key(11), lq=37, lk=64)
+        out = chunked_attention(q, k, v, chunk_size=16, q_chunk_size=8)
+        np.testing.assert_allclose(out, _reference_attention(q, k, v),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_q_chunked_gradients(self):
+        q, k, v = _qkv(jax.random.key(12), lq=24, lk=32)
+
+        def loss_a(q, k, v):
+            return chunked_attention(q, k, v, chunk_size=8,
+                                     q_chunk_size=8).sum()
+
+        def loss_b(q, k, v):
+            return _reference_attention(q, k, v).sum()
+
+        g1 = jax.grad(loss_a, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_b, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)

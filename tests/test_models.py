@@ -202,3 +202,30 @@ def test_model_under_jit():
                                np.asarray(model.apply(params, x,
                                                       policy=FP32)),
                                atol=1e-5)
+
+
+def test_attention_impl_parity_through_model():
+    """Encoder/decoder with chunked or flash attention match einsum."""
+    import dataclasses
+
+    input_adapter = ImageInputAdapter(image_shape=(14, 14, 1),
+                                      num_frequency_bands=8)
+    output_adapter = ClassificationOutputAdapter(num_classes=10)
+    enc = PerceiverEncoder(input_adapter=input_adapter,
+                           latent_shape=(16, 32), num_layers=2,
+                           num_self_attention_layers_per_block=2)
+    dec = PerceiverDecoder(output_adapter=output_adapter,
+                           latent_shape=(16, 32),
+                           num_cross_attention_heads=1)
+    model = PerceiverIO(enc, dec)
+    params = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 14, 14, 1))
+    ref = model.apply(params, x, policy=FP32)
+
+    for impl in ("chunked", "flash"):
+        m2 = PerceiverIO(
+            dataclasses.replace(enc, attention_impl=impl, kv_chunk_size=64),
+            dataclasses.replace(dec, attention_impl=impl, kv_chunk_size=64))
+        out = m2.apply(params, x, policy=FP32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
