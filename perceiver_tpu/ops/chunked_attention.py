@@ -36,6 +36,38 @@ def pad_mask_to_bias(key_padding_mask, dtype=jnp.float32):
     return jnp.where(key_padding_mask, NEG_INF, 0.0).astype(dtype)
 
 
+def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc):
+    """One online-softmax block fold — THE shared recurrence.
+
+    Folds a key/value block into running statistics. Used by the kv
+    scan here and by the ring/sequence-parallel paths
+    (``perceiver_tpu.parallel.ring_attention``), so all blockwise
+    implementations share one copy of the numerics (including the
+    uniform-average convention for fully-masked rows — all-NEG_INF
+    logits give p = 1, matching plain softmax's uniform weights).
+
+    q: (B,H,Lq,D); k_blk, v_blk: (B,H,Lk,D); bias_blk: (B,Lk) or None;
+    m, l: (B,H,Lq,1); acc: (B,H,Lq,D) — fp32 accumulators.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    if bias_blk is not None:
+        s = s + bias_blk[:, None, None, :].astype(jnp.float32)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def finalize_softmax(l, acc, dtype):
+    """acc / l with a 0/0 guard (see fully-masked-row note above)."""
+    return (acc / jnp.maximum(l, 1e-30)).astype(dtype)
+
+
 def chunked_attention(q, k, v, *, bias: Optional[jax.Array] = None,
                       scale: Optional[float] = None,
                       chunk_size: int = 1024,
@@ -99,25 +131,11 @@ def chunked_attention(q, k, v, *, bias: Optional[jax.Array] = None,
     acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
 
     def body(carry, x):
-        m, l, acc = carry
         if bias is not None:
             k_i, v_i, b_i = x
         else:
-            k_i, v_i = x
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_i,
-                       preferred_element_type=jnp.float32) * scale
-        if bias is not None:
-            s = s + b_i[:, None, None, :]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v_i.dtype), v_i,
-            preferred_element_type=jnp.float32)
-        return (m_new, l_new, acc_new), None
+            (k_i, v_i), b_i = x, None
+        return fold_block(q, k_i, v_i, b_i, scale, *carry), None
 
     (m, l, acc), _ = jax.lax.scan(jax.checkpoint(body), (m0, l0, acc0), xs)
-    out = acc / jnp.maximum(l, 1e-30)
-    return out.astype(q.dtype)
+    return finalize_softmax(l, acc, q.dtype)

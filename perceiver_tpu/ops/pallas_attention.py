@@ -157,13 +157,21 @@ def _flash_bwd(scale, block_q, block_k, interpret, res, g):
     # memory-bounded on BOTH axes: kv streams through the scan
     # (rematerialized), and the query axis is blocked like the forward
     # kernel grid (matters for the 262k-query decoder config).
+    if bias is None:
+        _, vjp = jax.vjp(
+            lambda a, b_, c: chunked_attention(
+                a, b_, c, scale=scale, chunk_size=block_k,
+                q_chunk_size=block_q * 8),
+            q, k, v)
+        return (*vjp(g), None)
+    # bias is differentiable (a learned additive key bias trains the
+    # same under impl="flash" as under "chunked"/"einsum")
     _, vjp = jax.vjp(
-        lambda a, b_, c: chunked_attention(
-            a, b_, c, bias=bias, scale=scale, chunk_size=block_k,
+        lambda a, b_, c, bi: chunked_attention(
+            a, b_, c, bias=bi, scale=scale, chunk_size=block_k,
             q_chunk_size=block_q * 8),
-        q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, jnp.zeros_like(bias) if bias is not None else None
+        q, k, v, bias)
+    return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

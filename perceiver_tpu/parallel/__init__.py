@@ -9,6 +9,12 @@ model-axis sharding covers the v5p-16 tensor-parallel config).
 """
 
 from perceiver_tpu.parallel.mesh import make_mesh, distributed_init  # noqa: F401
+from perceiver_tpu.parallel.ring_attention import (  # noqa: F401
+    make_ring_attention,
+    make_seq_parallel_cross_attention,
+    ring_attention,
+    seq_parallel_cross_attention,
+)
 from perceiver_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     param_sharding,

@@ -102,6 +102,25 @@ class TestFlash:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
+    def test_bias_gradient_matches(self):
+        """A differentiable (learned) additive key bias must get the
+        same gradient as the materialized-softmax path — the VJP must
+        not silently zero it."""
+        q, k, v = _qkv(jax.random.key(8), lk=48)
+        bias0 = jnp.zeros((q.shape[0], k.shape[2]), jnp.float32)
+
+        def loss_flash(b):
+            return (flash_attention(q, k, v, bias=b, block_q=8,
+                                    block_k=16) ** 2).sum()
+
+        def loss_ref(b):
+            return (_reference_attention(q, k, v, bias=b) ** 2).sum()
+
+        g1 = jax.grad(loss_flash)(bias0)
+        g2 = jax.grad(loss_ref)(bias0)
+        assert float(jnp.abs(g1).max()) > 0
+        np.testing.assert_allclose(g1, g2, atol=1e-4, rtol=1e-4)
+
     def test_under_jit(self):
         q, k, v = _qkv(jax.random.key(7))
         out = jax.jit(lambda *a: flash_attention(*a, block_q=8,
