@@ -24,6 +24,11 @@ def main():
 
     from perceiver_tpu.ops.policy import Policy
     from perceiver_tpu.tasks import MaskedLanguageModelTask
+    from perceiver_tpu.utils.flops import (
+        device_peak_flops,
+        mfu,
+        step_flops_and_fn,
+    )
 
     seq_len, vocab = 512, 10003
     batch_size = 64
@@ -53,7 +58,9 @@ def main():
         return optax.apply_updates(params, updates), opt_state, loss
 
     key = jax.random.key(1)
-    # warmup/compile
+    step_flops, train_step = step_flops_and_fn(train_step, params,
+                                               opt_state, ids, pad, key)
+    # warmup (compile already done when step_flops_and_fn AOT-compiled)
     params, opt_state, loss = train_step(params, opt_state, ids, pad, key)
     jax.block_until_ready(loss)
 
@@ -68,6 +75,8 @@ def main():
 
     steps_per_sec = n_steps / dt
     tokens_per_sec = steps_per_sec * batch_size * seq_len
+    util = mfu(step_flops, n_steps, dt,
+               peak_flops_per_device=device_peak_flops())
 
     print(json.dumps({
         "metric": "imdb_mlm_tokens_per_sec_per_chip",
@@ -79,6 +88,9 @@ def main():
             "batch_size": batch_size,
             "steps_per_sec": round(steps_per_sec, 3),
             "precision": "bf16",
+            "mfu": round(util, 4) if util is not None else None,
+            "step_tflops": (round(step_flops / 1e12, 3)
+                            if step_flops else None),
             "loss": float(loss),
             "device": str(jax.devices()[0]),
         },

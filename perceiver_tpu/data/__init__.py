@@ -3,3 +3,4 @@
 from perceiver_tpu.data.core import ArrayDataset, BatchIterator  # noqa: F401
 from perceiver_tpu.data.mnist import MNISTDataModule  # noqa: F401
 from perceiver_tpu.data.imdb import IMDBDataModule, Collator  # noqa: F401
+from perceiver_tpu.data.lartpc import load_lartpc, synthetic_events  # noqa: F401

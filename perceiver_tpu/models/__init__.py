@@ -7,3 +7,4 @@ from perceiver_tpu.models.perceiver import (  # noqa: F401
     PerceiverMLM,
 )
 from perceiver_tpu.models.masking import TextMasking  # noqa: F401
+from perceiver_tpu.models.uresnet import UResNet  # noqa: F401

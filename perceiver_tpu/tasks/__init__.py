@@ -4,3 +4,4 @@
 from perceiver_tpu.tasks.image import ImageClassifierTask  # noqa: F401
 from perceiver_tpu.tasks.text import TextClassifierTask  # noqa: F401
 from perceiver_tpu.tasks.mlm import MaskedLanguageModelTask  # noqa: F401
+from perceiver_tpu.tasks.segmentation import SegmentationTask  # noqa: F401

@@ -31,6 +31,11 @@ from perceiver_tpu.ops.policy import Policy
 from perceiver_tpu.training.checkpoint import CheckpointHook
 from perceiver_tpu.training.optim import create_optimizer
 from perceiver_tpu.training.state import TrainState
+from perceiver_tpu.utils.flops import (
+    device_peak_flops,
+    mfu,
+    step_flops_and_fn,
+)
 from perceiver_tpu.utils.tb import SummaryWriter
 
 _UNLIMITED_EPOCHS = 1000  # Lightning's default cap for max_epochs=-1
@@ -102,6 +107,11 @@ class Trainer:
         self._ckpt: Optional[CheckpointHook] = None
         self._train_step = None
         self._eval_step = None
+        # MFU accounting (SURVEY §5 profiling; BASELINE.md north star)
+        self._step_flops: Optional[float] = None
+        self._peak_flops = device_peak_flops(
+            precision="bf16" if self.policy.compute_dtype != np.float32
+            else "fp32")
 
     # --- setup ---------------------------------------------------------------
 
@@ -250,7 +260,7 @@ class Trainer:
             jax.profiler.start_trace(os.path.join(self.log_dir, "profile"))
 
         stop = False
-        t0, samples_since = time.time(), 0
+        t0, samples_since, steps_since = time.time(), 0, 0
         for epoch in range(max_epochs):
             self.current_epoch = epoch
             train_loader.set_epoch(epoch)
@@ -258,13 +268,32 @@ class Trainer:
                 if limit_train is not None and i >= limit_train:
                     break
                 batch_size = len(batch["valid"])
-                state, metrics = self._train_step(
-                    state, self._shard_batch(batch))
+                sharded = self._shard_batch(batch)
+                first_step = self._step_flops is None
+                if first_step:
+                    # cost analysis via lowering, or via the AOT compile
+                    # the first call would do anyway — never an extra one
+                    flops, self._train_step = step_flops_and_fn(
+                        self._train_step, state, sharded,
+                        num_devices=(self.mesh.devices.size
+                                     if self.mesh is not None else 1))
+                    self._step_flops = flops or 0.0
+                state, metrics = self._train_step(state, sharded)
                 self.global_step += 1
                 samples_since += batch_size
+                steps_since += 1
+                if first_step:
+                    # the first call paid jit compilation; keep it out
+                    # of the throughput/MFU measurement window
+                    jax.block_until_ready(metrics)
+                    t0, samples_since, steps_since = time.time(), 0, 0
 
                 if self.global_step % cfg.log_every_n_steps == 0 \
                         or cfg.fast_dev_run:
+                    # async dispatch: sync on the device before taking
+                    # dt, else the window measures host dispatch time
+                    # and over-reports throughput/MFU
+                    jax.block_until_ready(metrics)
                     dt = time.time() - t0
                     throughput = samples_since / max(dt, 1e-9)
                     for k, v in metrics.items():
@@ -277,9 +306,19 @@ class Trainer:
                     self.writer.add_scalar(
                         "lr", float(self.lr_fn(opt_step)),
                         self.global_step)
-                    self.writer.add_scalar("samples_per_sec", throughput,
-                                           self.global_step)
-                    t0, samples_since = time.time(), 0
+                    if steps_since > 0:
+                        self.writer.add_scalar("samples_per_sec",
+                                               throughput,
+                                               self.global_step)
+                    n_dev = (self.mesh.devices.size
+                             if self.mesh is not None else 1)
+                    util = mfu(self._step_flops, steps_since, dt,
+                               num_devices=n_dev,
+                               peak_flops_per_device=self._peak_flops)
+                    if util is not None:
+                        self.writer.add_scalar("mfu", util,
+                                               self.global_step)
+                    t0, samples_since, steps_since = time.time(), 0, 0
 
                 if cfg.max_steps > 0 and self.global_step >= cfg.max_steps:
                     stop = True
@@ -295,6 +334,9 @@ class Trainer:
                     self.task.on_validation_epoch_end(self, state)
                 if self._ckpt is not None and val_metrics:
                     self._ckpt.save(self.global_step, state, val_metrics)
+                # eval/checkpoint wall time must not depress the next
+                # window's samples_per_sec / mfu scalars
+                t0, samples_since, steps_since = time.time(), 0, 0
             if stop:
                 break
 
