@@ -12,6 +12,7 @@ bf16. Prints ONE JSON line.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -31,7 +32,10 @@ def main():
     )
 
     seq_len, vocab = 512, 10003
-    batch_size = 64
+    # tokens/sec/chip is the metric; batch size is free. The default is
+    # the best measured on v5e (see scripts/bench_sweep.py); override
+    # with BENCH_BATCH for sweeps.
+    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
     task = MaskedLanguageModelTask(vocab_size=vocab, max_seq_len=seq_len)
     model = task.build()
     policy = Policy.bf16()

@@ -132,6 +132,10 @@ def load_lartpc(files: Optional[Sequence[str]] = None, size: int = 512,
                 num_synthetic: int = 64, seed: int = 0,
                 min_pixels: Optional[int] = None) -> ArrayDataset:
     """Resolve the best available source and apply the occupancy filter."""
+    if files is not None and len(files) == 0:
+        raise ValueError(
+            "Empty file list: pass event files or omit --files entirely "
+            "for the synthetic generator")
     if files:
         if all(str(f).endswith(".npz") for f in files):
             ds = load_npz_events(files)

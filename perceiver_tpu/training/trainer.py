@@ -63,11 +63,16 @@ class TrainerConfig:
     save_top_k: int = 1
     resume_from_checkpoint: Optional[str] = None
     detect_anomaly: bool = False
+    # stop training when the loss goes non-finite (trainer.yaml:71).
+    # Checked at the already-synced log boundaries so the async
+    # pipeline is never broken just for the guard.
+    terminate_on_nan: bool = False
     profiler: Optional[str] = None
     seed: int = 42
     # informational parity flags (mesh decides actual placement)
     accelerator: str = "auto"
     devices: Any = "auto"
+    num_nodes: int = 1
 
     def policy(self) -> Policy:
         if str(self.precision) in ("32", "fp32", "32-true"):
@@ -294,6 +299,11 @@ class Trainer:
                     # dt, else the window measures host dispatch time
                     # and over-reports throughput/MFU
                     jax.block_until_ready(metrics)
+                    if cfg.terminate_on_nan and not np.isfinite(
+                            float(metrics.get("loss", 0.0))):
+                        raise FloatingPointError(
+                            f"Non-finite loss at step {self.global_step}"
+                            " (terminate_on_nan)")
                     dt = time.time() - t0
                     throughput = samples_since / max(dt, 1e-9)
                     for k, v in metrics.items():

@@ -86,10 +86,11 @@ def main():
                           num_synthetic=args.num_synthetic, seed=args.seed)
     n = len(dataset)
     print(f"num entries: {n}")
-    n_val = min(args.val_events, max(1, n // 8))
+    n_val = min(args.val_events, max(1, n // 8)) if args.val_events > 0 \
+        else 0
     perm = np.random.default_rng(args.seed).permutation(n)
-    train_ds = dataset.subset(perm[:-n_val])
-    val_ds = dataset.subset(perm[-n_val:])
+    train_ds = dataset.subset(perm[:n - n_val])
+    val_ds = dataset.subset(perm[n - n_val:])
     train_it = BatchIterator(train_ds, args.batch_size, shuffle=True,
                              seed=args.seed, drop_last=True)
     val_it = BatchIterator(val_ds, args.batch_size, drop_last=True)

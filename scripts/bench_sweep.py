@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Batch-size sweep for the headline MLM benchmark.
+
+Runs ``bench.py`` once per batch size in a fresh process (the TPU
+runtime holds device state per process) and prints a table. Used to
+pick the default ``batch_size`` baked into ``bench.py``; tokens/sec is
+the metric, so batch size is a free parameter (BASELINE.md).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+BATCHES = [int(b) for b in (sys.argv[1:] or [64, 128, 256, 512])]
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+best = None
+for b in BATCHES:
+    env = dict(os.environ, BENCH_BATCH=str(b))
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=900)
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        r = json.loads(line)
+        tps = r["value"]
+        print(f"batch {b:5d}: {tps:12.1f} tokens/s  "
+              f"mfu={r['detail'].get('mfu')}  "
+              f"step={1000 / r['detail']['steps_per_sec']:.1f} ms")
+        if best is None or tps > best[1]:
+            best = (b, tps)
+    except Exception as e:  # noqa: BLE001 — report and keep sweeping
+        print(f"batch {b:5d}: FAILED ({e})")
+
+if best:
+    print(f"\nbest: batch {best[0]} at {best[1]:.1f} tokens/s")

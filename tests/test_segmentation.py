@@ -130,3 +130,24 @@ def test_run_script_end_to_end(tmp_path, monkeypatch):
     assert ckpts, "final checkpoint not written"
     events = list((tmp_path / "logs").glob("events.out.tfevents.*"))
     assert events, "TensorBoard event file not written"
+
+
+def test_load_lartpc_rejects_empty_file_list():
+    with pytest.raises(ValueError, match="Empty file list"):
+        load_lartpc([], size=32)
+
+
+def test_run_script_val_events_zero(tmp_path, monkeypatch):
+    """--val-events 0 must train on everything and skip validation,
+    not invert the split."""
+    import run as run_mod
+
+    monkeypatch.setattr(
+        sys, "argv",
+        ["run.py", "--size", "32", "--num-synthetic", "8",
+         "--epochs", "1", "--batch-size", "2", "--val-events", "0",
+         "--precision", "32",
+         "--logdir", str(tmp_path / "logs"),
+         "--ckpt-dir", str(tmp_path / "ckpt")])
+    run_mod.main()
+    assert list((tmp_path / "ckpt").glob("model_*"))

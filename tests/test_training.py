@@ -189,3 +189,30 @@ def test_trainer_on_virtual_mesh(tmp_path):
                       optimizer_init=ADAMW, mesh=mesh)
     state = trainer.fit()
     assert int(state.step) == 2
+
+
+def test_terminate_on_nan_raises(tmp_path):
+    """trainer.yaml:71 parity: a non-finite loss must abort the run
+    instead of silently training on garbage."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    @dataclasses.dataclass(frozen=True)
+    class PoisonedTask(ImageClassifierTask):
+        def loss_and_metrics(self, *args, **kwargs):
+            loss, metrics = super().loss_and_metrics(*args, **kwargs)
+            loss = loss * jnp.nan
+            return loss, {**metrics, "loss": loss}
+
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=32, synthetic_test_size=16)
+    trainer = Trainer(
+        PoisonedTask(**dataclasses.asdict(small_image_task())), dm,
+        TrainerConfig(max_steps=2, max_epochs=1, num_sanity_val_steps=0,
+                      log_every_n_steps=1, terminate_on_nan=True,
+                      default_root_dir=str(tmp_path / "logs"),
+                      enable_checkpointing=False),
+        optimizer_init=ADAMW)
+    with pytest.raises(FloatingPointError, match="terminate_on_nan"):
+        trainer.fit()
