@@ -175,6 +175,11 @@ class PerceiverEncoder:
     # latent array always uses the einsum path.
     attention_impl: Optional[str] = None
     kv_chunk_size: int = 1024
+    # Rematerialize each perceiver layer (cross-attn + self-attn block)
+    # on the backward pass: activations inside a layer are recomputed
+    # instead of stored, trading FLOPs for HBM — the lever that fits
+    # the seq-2048 / 12-block configs (BASELINE.md configs[4]).
+    remat: bool = False
 
     def _layer_init(self, key):
         kc, ks = jax.random.split(key)
@@ -227,8 +232,15 @@ class PerceiverEncoder:
             (b, *self.latent_shape))
 
         k1, kn = jax.random.split(_rng_or_dummy(rng, deterministic))
-        latent = self._layer_apply(params["layer_1"], latent, x, pad_mask,
-                                   attn_mask, k1, deterministic, policy)
+
+        def one_layer(layer_params, latent, k):
+            return self._layer_apply(layer_params, latent, x, pad_mask,
+                                     attn_mask, k, deterministic, policy)
+
+        if self.remat:
+            one_layer = jax.checkpoint(one_layer)
+
+        latent = one_layer(params["layer_1"], latent, k1)
         if self.num_layers > 1:
             # Weight-shared recurrence (model.py:186-187): one compiled
             # body, scanned num_layers-1 times over per-iteration keys.
@@ -236,9 +248,7 @@ class PerceiverEncoder:
             layer_n = params["layer_n"]
 
             def body(carry, k):
-                out = self._layer_apply(layer_n, carry, x, pad_mask,
-                                        attn_mask, k, deterministic, policy)
-                return out, None
+                return one_layer(layer_n, carry, k), None
 
             latent, _ = jax.lax.scan(body, latent, keys)
         return latent, pad_mask

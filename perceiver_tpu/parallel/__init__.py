@@ -18,5 +18,6 @@ from perceiver_tpu.parallel.ring_attention import (  # noqa: F401
 from perceiver_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     param_sharding,
+    seq_sharding,
     shard_params,
 )

@@ -82,3 +82,15 @@ def shard_params(params, mesh: Mesh):
 def batch_sharding(mesh: Mesh, extra: Optional[tuple] = None):
     """Batch-axis (data-parallel) sharding for input arrays."""
     return NamedSharding(mesh, P("data", *(extra or ())))
+
+
+def seq_sharding(mesh: Mesh):
+    """(B, L, ...) sharding with the token axis over the ``seq`` mesh
+    axis — the pjit form of sequence parallelism: GSPMD partitions the
+    encoder's cross-attention over the kv/sequence axis and inserts
+    the softmax-statistics collectives itself (the manual-control
+    alternative is ``ring_attention`` under shard_map)."""
+    if "seq" not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no 'seq' axis; "
+                         "build it with make_mesh(..., seq_parallel=N)")
+    return NamedSharding(mesh, P("data", "seq"))

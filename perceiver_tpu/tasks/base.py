@@ -31,6 +31,9 @@ class TaskConfig:
     num_encoder_self_attention_layers_per_block: int = 6
     num_decoder_cross_attention_heads: int = 4
     dropout: float = 0.0
+    # rematerialize encoder layers on backward (memory ↔ FLOPs trade
+    # for the large configs; see PerceiverEncoder.remat)
+    remat: bool = False
 
     @property
     def latent_shape(self) -> Tuple[int, int]:

@@ -37,7 +37,8 @@ class ImageClassifierTask(TaskConfig):
             num_self_attention_heads=self.num_encoder_self_attention_heads,
             num_self_attention_layers_per_block=(
                 self.num_encoder_self_attention_layers_per_block),
-            dropout=self.dropout)
+            dropout=self.dropout,
+            remat=self.remat)
         decoder = PerceiverDecoder(
             output_adapter=output_adapter,
             latent_shape=self.latent_shape,

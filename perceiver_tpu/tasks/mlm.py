@@ -43,7 +43,8 @@ def create_encoder(cfg: TaskConfig, vocab_size: int,
         num_self_attention_heads=cfg.num_encoder_self_attention_heads,
         num_self_attention_layers_per_block=(
             cfg.num_encoder_self_attention_layers_per_block),
-        dropout=cfg.dropout)
+        dropout=cfg.dropout,
+        remat=cfg.remat)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -71,7 +71,8 @@ class SegmentationTask(TaskConfig):
             num_self_attention_heads=self.num_encoder_self_attention_heads,
             num_self_attention_layers_per_block=(
                 self.num_encoder_self_attention_layers_per_block),
-            dropout=self.dropout)
+            dropout=self.dropout,
+            remat=self.remat)
         chunk = self.query_chunk_size
         if chunk is not None and self.num_pixels % chunk != 0:
             chunk = None  # tiny test configs: fall back to unchunked

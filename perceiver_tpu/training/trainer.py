@@ -197,6 +197,13 @@ class Trainer:
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
 
+    def _check_nan(self, metrics):
+        if self.config.terminate_on_nan and not np.isfinite(
+                float(metrics.get("loss", 0.0))):
+            raise FloatingPointError(
+                f"Non-finite loss at step {self.global_step}"
+                " (terminate_on_nan)")
+
     # --- loops ---------------------------------------------------------------
 
     def _run_eval(self, loader, limit: Optional[int], state: TrainState,
@@ -266,6 +273,7 @@ class Trainer:
 
         stop = False
         t0, samples_since, steps_since = time.time(), 0, 0
+        metrics = None
         for epoch in range(max_epochs):
             self.current_epoch = epoch
             train_loader.set_epoch(epoch)
@@ -299,11 +307,7 @@ class Trainer:
                     # dt, else the window measures host dispatch time
                     # and over-reports throughput/MFU
                     jax.block_until_ready(metrics)
-                    if cfg.terminate_on_nan and not np.isfinite(
-                            float(metrics.get("loss", 0.0))):
-                        raise FloatingPointError(
-                            f"Non-finite loss at step {self.global_step}"
-                            " (terminate_on_nan)")
+                    self._check_nan(metrics)
                     dt = time.time() - t0
                     throughput = samples_since / max(dt, 1e-9)
                     for k, v in metrics.items():
@@ -333,6 +337,13 @@ class Trainer:
                 if cfg.max_steps > 0 and self.global_step >= cfg.max_steps:
                     stop = True
                     break
+
+            # close the tail window: a run shorter than the log interval
+            # (or a NaN in the final partial window) must not complete
+            # and checkpoint silently. Gate on metrics, not the timing
+            # counter — the first-step compile reset zeroes the latter.
+            if cfg.terminate_on_nan and metrics is not None:
+                self._check_nan(metrics)
 
             if epoch % cfg.check_val_every_n_epoch == 0 or stop:
                 val_metrics = self._run_eval(

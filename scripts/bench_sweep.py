@@ -23,6 +23,10 @@ for b in BATCHES:
         out = subprocess.run(
             [sys.executable, os.path.join(ROOT, "bench.py")],
             env=env, capture_output=True, text=True, timeout=900)
+        if out.returncode != 0:
+            tail = "\n".join(out.stderr.splitlines()[-4:])
+            print(f"batch {b:5d}: FAILED rc={out.returncode}\n{tail}")
+            continue
         line = [ln for ln in out.stdout.splitlines()
                 if ln.startswith("{")][-1]
         r = json.loads(line)

@@ -191,9 +191,11 @@ def test_trainer_on_virtual_mesh(tmp_path):
     assert int(state.step) == 2
 
 
-def test_terminate_on_nan_raises(tmp_path):
+@pytest.mark.parametrize("log_every", [1, 50])
+def test_terminate_on_nan_raises(tmp_path, log_every):
     """trainer.yaml:71 parity: a non-finite loss must abort the run
-    instead of silently training on garbage."""
+    instead of silently training on garbage — both at log boundaries
+    and in a tail window shorter than the log interval."""
     import dataclasses
 
     import jax.numpy as jnp
@@ -210,7 +212,7 @@ def test_terminate_on_nan_raises(tmp_path):
     trainer = Trainer(
         PoisonedTask(**dataclasses.asdict(small_image_task())), dm,
         TrainerConfig(max_steps=2, max_epochs=1, num_sanity_val_steps=0,
-                      log_every_n_steps=1, terminate_on_nan=True,
+                      log_every_n_steps=log_every, terminate_on_nan=True,
                       default_root_dir=str(tmp_path / "logs"),
                       enable_checkpointing=False),
         optimizer_init=ADAMW)
