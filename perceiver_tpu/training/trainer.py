@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
 import time
 from typing import Any, Dict, Optional
 
@@ -68,6 +69,12 @@ class TrainerConfig:
     # pipeline is never broken just for the guard.
     terminate_on_nan: bool = False
     profiler: Optional[str] = None
+    # save a full-state checkpoint and stop cleanly on SIGTERM — TPU
+    # preemption notice. Beyond the reference's manual
+    # restart-from-checkpoint story (SURVEY §5 failure detection): the
+    # preempt save lands in <log_dir>/checkpoints-preempt and is picked
+    # up by resume_from_checkpoint like any other.
+    preempt_checkpoint: bool = True
     seed: int = 42
     # informational parity flags (mesh decides actual placement)
     accelerator: str = "auto"
@@ -112,6 +119,7 @@ class Trainer:
         self._ckpt: Optional[CheckpointHook] = None
         self._train_step = None
         self._eval_step = None
+        self._preempted = False
         # MFU accounting (SURVEY §5 profiling; BASELINE.md north star)
         self._step_flops: Optional[float] = None
         self._peak_flops = device_peak_flops(
@@ -197,6 +205,20 @@ class Trainer:
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
 
+    def _handle_preemption(self, state: TrainState) -> bool:
+        """Save full state to checkpoints-preempt and signal a clean
+        stop. Returns True when a preemption was handled."""
+        if not self._preempted:
+            return False
+        hook = CheckpointHook(
+            os.path.join(self.log_dir, "checkpoints-preempt"),
+            max_to_keep=1, monitor="", hparams=self._hparams())
+        hook.save(self.global_step, state, {})
+        hook.wait()
+        print(f"Preemption: saved step {self.global_step} to "
+              f"{os.path.join(self.log_dir, 'checkpoints-preempt')}")
+        return True
+
     def _check_nan(self, metrics):
         if self.config.terminate_on_nan and not np.isfinite(
                 float(metrics.get("loss", 0.0))):
@@ -225,6 +247,28 @@ class Trainer:
         return {f"{prefix}_{k}": v / count for k, v in totals.items()}
 
     def fit(self) -> TrainState:
+        """Train with SIGTERM (preemption) handling around the loop."""
+        installed, old_term = False, None
+        if self.config.preempt_checkpoint:
+            try:
+                old_term = signal.signal(
+                    signal.SIGTERM,
+                    lambda *_: setattr(self, "_preempted", True))
+                installed = True
+            except ValueError:
+                pass  # not on the main thread
+        try:
+            return self._fit()
+        finally:
+            if installed:
+                # old_term is None when the prior handler was installed
+                # at the C level — SIG_DFL is the closest restorable
+                # disposition (None is not accepted by signal.signal)
+                signal.signal(signal.SIGTERM,
+                              old_term if old_term is not None
+                              else signal.SIG_DFL)
+
+    def _fit(self) -> TrainState:
         cfg = self.config
         if cfg.detect_anomaly:
             jax.config.update("jax_debug_nans", True)
@@ -334,6 +378,11 @@ class Trainer:
                                                self.global_step)
                     t0, samples_since, steps_since = time.time(), 0, 0
 
+                if cfg.preempt_checkpoint and \
+                        self._handle_preemption(state):
+                    stop = True
+                    break
+
                 if cfg.max_steps > 0 and self.global_step >= cfg.max_steps:
                     stop = True
                     break
@@ -345,7 +394,8 @@ class Trainer:
             if cfg.terminate_on_nan and metrics is not None:
                 self._check_nan(metrics)
 
-            if epoch % cfg.check_val_every_n_epoch == 0 or stop:
+            if (epoch % cfg.check_val_every_n_epoch == 0 or stop) \
+                    and not self._preempted:  # grace window is short
                 val_metrics = self._run_eval(
                     self.datamodule.val_dataloader(), limit_val, state,
                     "val")

@@ -218,3 +218,57 @@ def test_terminate_on_nan_raises(tmp_path, log_every):
         optimizer_init=ADAMW)
     with pytest.raises(FloatingPointError, match="terminate_on_nan"):
         trainer.fit()
+
+
+def test_preemption_checkpoint_and_resume(tmp_path):
+    """SIGTERM mid-training must save full state to checkpoints-preempt
+    and stop cleanly; resume_from_checkpoint picks it up."""
+    import os
+    import signal as _signal
+
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=64, synthetic_test_size=32)
+    trainer = Trainer(small_image_task(), dm,
+                      TrainerConfig(max_steps=50, max_epochs=10,
+                                    num_sanity_val_steps=0,
+                                    log_every_n_steps=1,
+                                    default_root_dir=str(tmp_path / "logs"),
+                                    enable_checkpointing=False),
+                      optimizer_init=ADAMW)
+
+    handler_before = _signal.getsignal(_signal.SIGTERM)
+    fired = {"done": False}
+    orig_step = trainer._make_steps
+
+    def make_steps_and_arm():
+        orig_step()
+        inner = trainer._train_step
+
+        def stepper(state, batch):
+            out = inner(state, batch)
+            if not fired["done"]:
+                fired["done"] = True
+                os.kill(os.getpid(), _signal.SIGTERM)  # preempt notice
+            return out
+
+        trainer._train_step = stepper
+
+    trainer._make_steps = make_steps_and_arm
+    state = trainer.fit()
+    # stopped early, well before max_steps
+    assert trainer.global_step < 50
+    preempt_dir = os.path.join(trainer.log_dir, "checkpoints-preempt")
+    assert os.path.isdir(preempt_dir)
+    # the exact pre-fit handler is restored after fit
+    assert _signal.getsignal(_signal.SIGTERM) is handler_before
+
+    trainer2 = Trainer(small_image_task(), dm,
+                       TrainerConfig(max_steps=int(trainer.global_step) + 2,
+                                     max_epochs=10, num_sanity_val_steps=0,
+                                     log_every_n_steps=1,
+                                     default_root_dir=str(tmp_path / "l2"),
+                                     resume_from_checkpoint=preempt_dir,
+                                     enable_checkpointing=False),
+                       optimizer_init=ADAMW)
+    state2 = trainer2.fit()
+    assert int(state2.step) == int(trainer.global_step) + 2
