@@ -105,21 +105,73 @@ class SegmentationTask(TaskConfig):
         logits = self.forward(model, params, batch["image"], rng=rng,
                               deterministic=deterministic, policy=policy)
         labels = batch["label"].reshape(logits.shape[0], -1)
-        valid = batch.get("valid")
-        row = (valid.astype(jnp.float32)[:, None] if valid is not None
-               else jnp.ones((logits.shape[0], 1), jnp.float32))
+        return segmentation_loss_and_metrics(
+            logits, labels, self.class_weights(), batch.get("valid"))
 
-        # torch F.cross_entropy(weight=w) semantics: per-pixel nll
-        # scaled by w[label], normalized by the summed weights
-        logsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logsm, labels[..., None], -1)[..., 0]
-        w = self.class_weights()[labels] * row
-        loss = (nll * w).sum() / jnp.maximum(w.sum(), 1e-8)
 
-        pred = jnp.argmax(logits, axis=-1)
-        correct = (pred == labels).astype(jnp.float32)
-        metrics = {"loss": loss,
-                   "acc": masked_mean(correct, (labels > 0) * row)}
-        for c in range(1, self.num_classes):
-            metrics[f"acc{c}"] = masked_mean(correct, (labels == c) * row)
-        return loss, metrics
+def segmentation_loss_and_metrics(logits, labels, class_weights,
+                                  valid=None):
+    """Class-weighted CE + per-class accuracies over flattened pixels.
+
+    ``logits`` (B, P, C); ``labels`` (B, P). torch
+    ``F.cross_entropy(weight=w)`` semantics (run.py:234-237): per-pixel
+    nll scaled by ``w[label]``, normalized by the summed weights.
+    Shared by the Perceiver and U-ResNet segmentation paths.
+    """
+    num_classes = logits.shape[-1]
+    row = (valid.astype(jnp.float32)[:, None] if valid is not None
+           else jnp.ones((logits.shape[0], 1), jnp.float32))
+
+    logsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logsm, labels[..., None], -1)[..., 0]
+    w = class_weights[labels] * row
+    loss = (nll * w).sum() / jnp.maximum(w.sum(), 1e-8)
+
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels).astype(jnp.float32)
+    metrics = {"loss": loss,
+               "acc": masked_mean(correct, (labels > 0) * row)}
+    for c in range(1, num_classes):
+        metrics[f"acc{c}"] = masked_mean(correct, (labels == c) * row)
+    return loss, metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class UResNetSegmentationTask:
+    """Dense-conv alternative to the Perceiver segmentation model: the
+    U-ResNet the reference wires into ``LAr_Perceiver`` but never runs
+    (``run.py:103,109-110``; SURVEY §2.3) — here a first-class, actually
+    trainable choice (``run.py --model uresnet``).
+
+    ``loss_and_metrics`` returns ``(loss, metrics, new_state)``: the
+    third element is the updated BatchNorm running-stat pytree, which
+    the caller threads (it must not receive optimizer updates).
+    """
+
+    image_shape: Tuple[int, int, int] = (512, 512, 1)
+    num_classes: int = 3
+    inplanes: int = 16
+    background_weight: float = 0.0
+
+    def build(self):
+        from perceiver_tpu.models.uresnet import UResNet
+        return UResNet(num_classes=self.num_classes,
+                       input_channels=self.image_shape[-1],
+                       inplanes=self.inplanes)
+
+    def class_weights(self) -> jnp.ndarray:
+        w = jnp.ones((self.num_classes,), jnp.float32)
+        return w.at[0].set(self.background_weight)
+
+    def loss_and_metrics(self, model, variables, batch, *,
+                         train: bool = False,
+                         policy: Policy = DEFAULT_POLICY):
+        b = batch["image"].shape[0]
+        x = batch["image"].reshape(b, *self.image_shape)
+        logits, new_state = model.apply(variables, x, train=train,
+                                        policy=policy)
+        loss, metrics = segmentation_loss_and_metrics(
+            logits.reshape(b, -1, self.num_classes),
+            batch["label"].reshape(b, -1).astype(jnp.int32),
+            self.class_weights(), batch.get("valid"))
+        return loss, metrics, new_state

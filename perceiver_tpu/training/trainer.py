@@ -205,11 +205,27 @@ class Trainer:
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
 
+    def _preemption_pending(self) -> bool:
+        """Single-process: the SIGTERM flag. Multi-host: the orbax save
+        below is a collective, so hosts must agree on the step — defer
+        to JAX's coordinated sync point (driven by the coordination
+        service's preemption notice) instead of per-host signals, which
+        land at different loop positions on different hosts."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            try:
+                return bool(multihost_utils.reached_preemption_sync_point(
+                    int(self.global_step)))
+            except Exception:
+                return False
+        return self._preempted
+
     def _handle_preemption(self, state: TrainState) -> bool:
         """Save full state to checkpoints-preempt and signal a clean
         stop. Returns True when a preemption was handled."""
-        if not self._preempted:
+        if not self._preemption_pending():
             return False
+        self._preempted = True  # skip the validation pass on stop
         hook = CheckpointHook(
             os.path.join(self.log_dir, "checkpoints-preempt"),
             max_to_keep=1, monitor="", hparams=self._hparams())
@@ -248,6 +264,7 @@ class Trainer:
 
     def fit(self) -> TrainState:
         """Train with SIGTERM (preemption) handling around the loop."""
+        self._preempted = False  # a prior preempted fit() must not leak
         installed, old_term = False, None
         if self.config.preempt_checkpoint:
             try:

@@ -45,6 +45,13 @@ def parse_args():
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--files", nargs="*", default=None,
                    help="larcv ROOT or NPZ event files (default: synthetic)")
+    p.add_argument("--model", default="perceiver",
+                   choices=["perceiver", "uresnet"],
+                   help="perceiver = LAr_Perceiver config (run.py:72-103);"
+                        " uresnet = the dense U-ResNet the reference "
+                        "wires up but never runs")
+    p.add_argument("--inplanes", type=int, default=16,
+                   help="U-ResNet stem width (uresnet model only)")
     p.add_argument("--size", type=int, default=512,
                    help="image side (512 for real data)")
     p.add_argument("--num-synthetic", type=int, default=64)
@@ -74,11 +81,19 @@ def main():
     from perceiver_tpu.data.core import BatchIterator
     from perceiver_tpu.data.lartpc import load_lartpc
     from perceiver_tpu.ops.policy import Policy
-    from perceiver_tpu.tasks.segmentation import SegmentationTask
+    from perceiver_tpu.tasks.segmentation import (
+        SegmentationTask,
+        UResNetSegmentationTask,
+    )
     from perceiver_tpu.training.checkpoint import save_params
     from perceiver_tpu.utils.tb import SummaryWriter
 
-    task = SegmentationTask(image_shape=(args.size, args.size, 1))
+    use_uresnet = args.model == "uresnet"
+    if use_uresnet:
+        task = UResNetSegmentationTask(
+            image_shape=(args.size, args.size, 1), inplanes=args.inplanes)
+    else:
+        task = SegmentationTask(image_shape=(args.size, args.size, 1))
     model = task.build()
     policy = Policy.bf16() if args.precision == "bf16" else Policy.fp32()
 
@@ -100,7 +115,10 @@ def main():
             f"occupancy filter with batch_size={args.batch_size} "
             f"(drop_last). Lower --batch-size or provide more events.")
 
-    params = model.init(jax.random.key(args.seed))
+    if use_uresnet:
+        params, aux = model.init(jax.random.key(args.seed))
+    else:
+        params, aux = model.init(jax.random.key(args.seed)), None
     # torch Adam's weight_decay is L2-on-gradients, hence decayed
     # weights added *before* the Adam moment update (not AdamW order)
     tx = optax.chain(
@@ -113,14 +131,25 @@ def main():
     )
     opt_state = tx.init(params)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, batch, rng):
-        def loss_fn(p):
-            return task.loss_and_metrics(
-                model, p, batch, rng=rng, deterministic=False,
-                policy=policy)
+    def compute(p, aux, batch, rng, train):
+        """Unified (loss, metrics, new_aux): aux is the U-ResNet's
+        BatchNorm running stats (threaded, never optimized) and None
+        for the Perceiver."""
+        if use_uresnet:
+            return task.loss_and_metrics(model, (p, aux), batch,
+                                         train=train, policy=policy)
+        loss, metrics = task.loss_and_metrics(
+            model, p, batch, rng=rng, deterministic=not train,
+            policy=policy)
+        return loss, metrics, aux
 
-        (loss, metrics), grads = jax.value_and_grad(
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(params, aux, opt_state, batch, rng):
+        def loss_fn(p):
+            loss, metrics, new_aux = compute(p, aux, batch, rng, True)
+            return loss, (metrics, new_aux)
+
+        (loss, (metrics, new_aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         updates, opt_state = tx.update(grads, opt_state, params,
                                        value=loss)
@@ -128,12 +157,12 @@ def main():
         # donated back in, so the host can read them lazily, whereas
         # opt_state buffers die at the next step's donation
         metrics["lr_scale"] = opt_state[3].scale  # chain idx 3 = plateau
-        return optax.apply_updates(params, updates), opt_state, metrics
+        return (optax.apply_updates(params, updates), new_aux, opt_state,
+                metrics)
 
     @jax.jit
-    def eval_step(params, batch):
-        _, metrics = task.loss_and_metrics(model, params, batch,
-                                           policy=policy)
+    def eval_step(params, aux, batch):
+        _, metrics, _ = compute(params, aux, batch, None, False)
         return metrics
 
     writer = SummaryWriter(args.logdir)
@@ -166,8 +195,8 @@ def main():
         train_it.set_epoch(epoch)
         for batch in train_it:
             key, sub = jax.random.split(key)
-            params, opt_state, metrics = train_step(
-                params, opt_state,
+            params, aux, opt_state, metrics = train_step(
+                params, aux, opt_state,
                 {k: jnp.asarray(v) for k, v in batch.items()}, sub)
             pending.append((total_iter, metrics))
             if len(pending) >= FLUSH_EVERY:
@@ -177,8 +206,8 @@ def main():
 
         vlosses, vaccs = [], []
         for batch in val_it:
-            m = eval_step(params, {k: jnp.asarray(v)
-                                   for k, v in batch.items()})
+            m = eval_step(params, aux, {k: jnp.asarray(v)
+                                        for k, v in batch.items()})
             vlosses.append(float(m["loss"]))
             vaccs.append(float(m["acc"]))
         if vlosses:
@@ -188,10 +217,14 @@ def main():
             writer.add_scalar("val_acc", float(np.mean(vaccs)), total_iter)
 
     os.makedirs(args.ckpt_dir, exist_ok=True)
+    saved = {"params": params, "opt_state": opt_state,
+             "epoch": args.epochs - 1}
+    if aux is not None:
+        saved["batch_stats"] = aux
     save_params(os.path.join(args.ckpt_dir, f"model_{args.epochs - 1}"),
-                {"params": params, "opt_state": opt_state,
-                 "epoch": args.epochs - 1},
-                hparams={"task": "segmentation", "size": args.size})
+                saved,
+                hparams={"task": "segmentation", "model": args.model,
+                         "size": args.size})
     writer.close()
 
 

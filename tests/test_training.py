@@ -270,5 +270,8 @@ def test_preemption_checkpoint_and_resume(tmp_path):
                                      resume_from_checkpoint=preempt_dir,
                                      enable_checkpointing=False),
                        optimizer_init=ADAMW)
+    # a stale flag from a previous preempted fit must not leak into a
+    # new fit (fit() resets it)
+    trainer2._preempted = True
     state2 = trainer2.fit()
     assert int(state2.step) == int(trainer.global_step) + 2
