@@ -291,10 +291,15 @@ class PerceiverDecoder:
         }
 
     def apply(self, params, x, pad_mask=None, *, rng=None,
-              deterministic: bool = True, policy: Policy = DEFAULT_POLICY):
+              deterministic: bool = True, policy: Policy = DEFAULT_POLICY,
+              return_hidden: bool = False):
         """``pad_mask`` is accepted for the encoder-tuple contract but —
         matching the reference (model.py:229,236) — not applied in the
-        decoder cross-attention (the latent kv has no padding)."""
+        decoder cross-attention (the latent kv has no padding).
+
+        ``return_hidden=True`` skips the output adapter and returns the
+        pre-projection ``(B, K, C)`` query states — the hook for fused
+        projection+loss kernels (``perceiver_tpu.ops.fused_ce``)."""
         del pad_mask
         b, *d = x.shape
         if tuple(d) != tuple(self.latent_shape):
@@ -327,6 +332,8 @@ class PerceiverDecoder:
             out = out.swapaxes(0, 1).reshape(b, num_q, -1)
         else:
             out = run(query, _rng_or_dummy(rng, deterministic))
+        if return_hidden:
+            return out
         return self.output_adapter.apply(params["output_adapter"], out,
                                          policy=policy)
 
@@ -372,9 +379,14 @@ class PerceiverMLM:
 
     def apply(self, params, x_input, pad_mask=None, *, masking: bool = True,
               rng=None, deterministic: bool = True,
-              policy: Policy = DEFAULT_POLICY):
+              policy: Policy = DEFAULT_POLICY, return_hidden: bool = False):
         """Returns ``(logits, labels)``; ``labels`` is None when
-        ``masking=False`` (inference path, reference utils.py:30)."""
+        ``masking=False`` (inference path, reference utils.py:30).
+
+        ``return_hidden=True`` returns pre-vocab-projection decoder
+        states ``(B, l, C)`` instead of logits (fused-loss hook; the
+        vocab projection then happens inside the loss, see
+        ``perceiver_tpu.ops.fused_ce``)."""
         l = x_input.shape[1]
         if masking and rng is None:
             # a silent constant key would mask the same positions in
@@ -392,7 +404,8 @@ class PerceiverMLM:
         latent, _ = self.encoder.apply(
             params["encoder"], x_masked, pad_mask, rng=k_enc,
             deterministic=deterministic, policy=policy)
-        logits = self.decoder.apply(
+        out = self.decoder.apply(
             params["decoder"], latent, rng=k_dec,
-            deterministic=deterministic, policy=policy)[:, :l, :]
-        return logits, labels
+            deterministic=deterministic, policy=policy,
+            return_hidden=return_hidden)[:, :l, :]
+        return out, labels

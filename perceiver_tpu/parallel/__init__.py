@@ -15,6 +15,10 @@ from perceiver_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     seq_parallel_cross_attention,
 )
+from perceiver_tpu.parallel.ulysses import (  # noqa: F401
+    make_ulysses_attention,
+    ulysses_attention,
+)
 from perceiver_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     param_sharding,

@@ -54,6 +54,22 @@ class MaskedLanguageModelTask(TaskConfig):
     masked_samples: Optional[List[str]] = None
     num_predictions: int = 3
     mask_p: float = 0.15
+    # Loss implementation — all numerically equivalent (fp32 softmax):
+    #   "dense":  CE over materialized (B, M, V) logits (reference
+    #             lightning.py:223-226 semantics, literally).
+    #   "fused":  chunked projection+CE, never materializing the full
+    #             logits (ops/fused_ce.py) — O(chunk·V) peak memory.
+    #   "packed": fused CE over only the ~mask_p selected positions,
+    #             scatter-packed to a static capacity — identical loss
+    #             and gradients (zero-weight rows contribute zero), and
+    #             the dominant vocab projection shrinks ~1/mask_p×.
+    loss_impl: str = "packed"
+    ce_chunk_size: int = 8192
+    # packed-buffer capacity as a fraction of B·M. None derives
+    # 1.5 × mask_p — enough headroom that overflow (silently dropped
+    # rows) has negligible probability at these sizes regardless of
+    # the configured masking rate
+    packed_capacity: Optional[float] = None
 
     def build(self) -> PerceiverMLM:
         encoder = create_encoder(self, self.vocab_size, self.max_seq_len)
@@ -94,9 +110,46 @@ class MaskedLanguageModelTask(TaskConfig):
     def loss_and_metrics(self, model, params, batch, *, rng=None,
                          deterministic: bool = True,
                          policy: Policy = DEFAULT_POLICY):
-        logits, labels = model.apply(
+        if self.loss_impl == "dense":
+            logits, labels = model.apply(
+                params, batch["input_ids"], batch["pad_mask"], rng=rng,
+                deterministic=deterministic, policy=policy)
+            loss = cross_entropy(logits, labels, batch.get("valid"),
+                                 ignore_index=IGNORE)
+            return loss, {"loss": loss}
+
+        import jax.numpy as jnp
+
+        from perceiver_tpu.ops.fused_ce import (
+            fused_linear_cross_entropy,
+            pack_positions,
+        )
+
+        hidden, labels = model.apply(
             params, batch["input_ids"], batch["pad_mask"], rng=rng,
-            deterministic=deterministic, policy=policy)
-        loss = cross_entropy(logits, labels, batch.get("valid"),
-                             ignore_index=IGNORE)
+            deterministic=deterministic, policy=policy, return_hidden=True)
+        b, l, c = hidden.shape
+        weight = (labels != IGNORE).astype(jnp.float32)
+        valid = batch.get("valid")
+        if valid is not None:
+            weight = weight * valid.astype(jnp.float32)[:, None]
+        hidden = hidden.reshape(b * l, c)
+        labels = labels.reshape(b * l)
+        weight = weight.reshape(b * l)
+        if self.loss_impl == "packed":
+            frac = (self.packed_capacity if self.packed_capacity is not None
+                    else 1.5 * self.mask_p)
+            cap = int(b * l * min(frac, 1.0))
+            cap = min(max(cap, 1), b * l)
+            hidden, labels, weight = pack_positions(hidden, labels, weight,
+                                                    cap)
+        elif self.loss_impl != "fused":
+            raise ValueError(
+                f"unknown loss_impl {self.loss_impl!r}; expected "
+                "'dense', 'fused', or 'packed'")
+        adapter_params = params["decoder"]["output_adapter"]["linear"]
+        loss = fused_linear_cross_entropy(
+            adapter_params, hidden, labels, weight,
+            chunk_size=min(self.ce_chunk_size, hidden.shape[0]),
+            policy=policy)
         return loss, {"loss": loss}
