@@ -98,9 +98,27 @@ def mha_apply(params, q, k, v, *, num_heads: int,
                 f"impl={impl!r} does not support attention-weight "
                 "dropout; use the einsum impl")
 
-    qh = _split_heads(linear_apply(params["q"], q, policy=policy), num_heads)
-    kh = _split_heads(linear_apply(params["k"], k, policy=policy), num_heads)
-    vh = _split_heads(linear_apply(params["v"], v, policy=policy), num_heads)
+    if k is q and v is q:
+        # self-attention: pack the three projections into ONE matmul
+        # (torch's in_proj). Identical numerics — the concatenated
+        # weight produces the same three output blocks — but a single
+        # wider MXU op instead of three skinny ones, which matters for
+        # dispatch-bound small-channel configs.
+        w = jnp.concatenate([params[n]["w"] for n in ("q", "k", "v")],
+                            axis=1)
+        b = jnp.concatenate([params[n]["b"] for n in ("q", "k", "v")])
+        qkv = (policy.cast_compute(q) @ policy.cast_param(w)
+               + policy.cast_param(b))
+        e = qkv.shape[-1] // 3
+        qh, kh, vh = (_split_heads(qkv[..., i * e:(i + 1) * e], num_heads)
+                      for i in range(3))
+    else:
+        qh = _split_heads(linear_apply(params["q"], q, policy=policy),
+                          num_heads)
+        kh = _split_heads(linear_apply(params["k"], k, policy=policy),
+                          num_heads)
+        vh = _split_heads(linear_apply(params["v"], v, policy=policy),
+                          num_heads)
 
     head_dim = qh.shape[-1]
     if impl in ("chunked", "flash"):

@@ -66,10 +66,17 @@ class MaskedLanguageModelTask(TaskConfig):
     loss_impl: str = "packed"
     ce_chunk_size: int = 8192
     # packed-buffer capacity as a fraction of B·M. None derives
-    # 1.5 × mask_p — enough headroom that overflow (silently dropped
-    # rows) has negligible probability at these sizes regardless of
-    # the configured masking rate
+    # 1.5 × mask_p plus an additive ~6σ Binomial tail margin (computed
+    # at loss time from the actual B·M), so overflow — which silently
+    # drops rows — stays negligible at SMALL batch·seq products too,
+    # not just asymptotically
     packed_capacity: Optional[float] = None
+
+    def __post_init__(self):
+        if self.loss_impl not in ("dense", "fused", "packed"):
+            raise ValueError(
+                f"unknown loss_impl {self.loss_impl!r}; expected "
+                "'dense', 'fused', or 'packed'")
 
     def build(self) -> PerceiverMLM:
         encoder = create_encoder(self, self.vocab_size, self.max_seq_len)
@@ -137,16 +144,17 @@ class MaskedLanguageModelTask(TaskConfig):
         labels = labels.reshape(b * l)
         weight = weight.reshape(b * l)
         if self.loss_impl == "packed":
-            frac = (self.packed_capacity if self.packed_capacity is not None
-                    else 1.5 * self.mask_p)
-            cap = int(b * l * min(frac, 1.0))
-            cap = min(max(cap, 1), b * l)
+            n = b * l
+            if self.packed_capacity is not None:
+                cap = int(n * min(self.packed_capacity, 1.0))
+            else:
+                # mean + ~6σ Binomial(n, 1.5·mask_p) tail: the σ term is
+                # what keeps overflow negligible when n is small
+                p = 1.5 * self.mask_p
+                cap = int(n * p + 6.0 * (n * p) ** 0.5) + 8
+            cap = min(max(cap, 1), n)
             hidden, labels, weight = pack_positions(hidden, labels, weight,
                                                     cap)
-        elif self.loss_impl != "fused":
-            raise ValueError(
-                f"unknown loss_impl {self.loss_impl!r}; expected "
-                "'dense', 'fused', or 'packed'")
         adapter_params = params["decoder"]["output_adapter"]["linear"]
         loss = fused_linear_cross_entropy(
             adapter_params, hidden, labels, weight,

@@ -73,6 +73,13 @@ class TrainerConfig:
     # background prefetch queue (the torch-DataLoader-workers analogue,
     # reference data/imdb.py:112-126; 0 disables)
     prefetch_batches: int = 2
+    # optimizer steps per device dispatch: K batches are stacked on the
+    # host and scanned on-device (lax.scan), amortizing host→device
+    # dispatch latency over K steps — the dominant overhead for small
+    # per-step compute on TPU. 1 = classic one-dispatch-per-step.
+    # Logging/val/preemption/max_steps all operate at dispatch
+    # boundaries; a trailing group smaller than K runs step-by-step.
+    steps_per_execution: int = 1
     # save a full-state checkpoint and stop cleanly on SIGTERM — TPU
     # preemption notice. Beyond the reference's manual
     # restart-from-checkpoint story (SURVEY §5 failure detection): the
@@ -122,6 +129,7 @@ class Trainer:
         self.writer: Optional[SummaryWriter] = None
         self._ckpt: Optional[CheckpointHook] = None
         self._train_step = None
+        self._train_step_multi = None
         self._eval_step = None
         self._preempted = False
         # MFU accounting (SURVEY §5 profiling; BASELINE.md north star)
@@ -166,11 +174,13 @@ class Trainer:
             state = jax.device_put(state, replicated)
         return state
 
-    def _shard_batch(self, batch: Dict[str, np.ndarray]):
+    def _shard_batch(self, batch: Dict[str, np.ndarray], *,
+                     stacked: bool = False):
         if self.mesh is None:
             return batch
-        sharding = jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec("data"))
+        spec = (jax.sharding.PartitionSpec(None, "data") if stacked
+                else jax.sharding.PartitionSpec("data"))
+        sharding = jax.sharding.NamedSharding(self.mesh, spec)
         return {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
     def _make_steps(self):
@@ -206,7 +216,14 @@ class Trainer:
                 else next(iter(batch.values())).shape[0]
             return metrics, n
 
+        def train_step_multi(state: TrainState, stacked):
+            """K steps in one dispatch: scan train_step over the leading
+            axis of a stacked batch dict. Metrics are window means."""
+            state, metrics = jax.lax.scan(train_step, state, stacked)
+            return state, jax.tree.map(lambda m: m.mean(0), metrics)
+
         self._train_step = jax.jit(train_step, donate_argnums=0)
+        self._train_step_multi = jax.jit(train_step_multi, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
 
     def _preemption_pending(self) -> bool:
@@ -340,38 +357,74 @@ class Trainer:
         if cfg.profiler:
             jax.profiler.start_trace(os.path.join(self.log_dir, "profile"))
 
+        import itertools
+
+        # optimizer steps per device dispatch (lax.scan over stacked
+        # batches). fast_dev_run stays single-step for debuggability.
+        spe = 1 if cfg.fast_dev_run else max(cfg.steps_per_execution, 1)
+
         stop = False
         t0, samples_since, steps_since = time.time(), 0, 0
         metrics = None
         for epoch in range(max_epochs):
             self.current_epoch = epoch
             train_loader.set_epoch(epoch)
-            for i, batch in enumerate(train_loader):
-                if limit_train is not None and i >= limit_train:
+
+            def epoch_batches():
+                for i, b in enumerate(train_loader):
+                    if limit_train is not None and i >= limit_train:
+                        return
+                    yield b
+
+            batch_iter = epoch_batches()
+            while True:
+                remaining = (cfg.max_steps - self.global_step
+                             if cfg.max_steps > 0 else spe)
+                group = list(itertools.islice(batch_iter,
+                                              max(min(spe, remaining), 1)))
+                if not group:
                     break
-                batch_size = len(batch["valid"])
-                sharded = self._shard_batch(batch)
+                batch_size = sum(len(b["valid"]) for b in group)
+                prev_step = self.global_step
                 first_step = self._step_flops is None
-                if first_step:
-                    # cost analysis via lowering, or via the AOT compile
-                    # the first call would do anyway — never an extra one
-                    flops, self._train_step = step_flops_and_fn(
-                        self._train_step, state, sharded,
-                        num_devices=(self.mesh.devices.size
-                                     if self.mesh is not None else 1))
-                    self._step_flops = flops or 0.0
-                state, metrics = self._train_step(state, sharded)
-                self.global_step += 1
+                if len(group) == spe and spe > 1:
+                    stacked = {key: np.stack([b[key] for b in group])
+                               for key in group[0]}
+                    sharded = self._shard_batch(stacked, stacked=True)
+                    if first_step:
+                        flops, self._train_step_multi = step_flops_and_fn(
+                            self._train_step_multi, state, sharded,
+                            num_devices=(self.mesh.devices.size
+                                         if self.mesh is not None else 1))
+                        self._step_flops = flops or 0.0
+                    state, metrics = self._train_step_multi(state, sharded)
+                else:
+                    # trailing (or single-step-mode) group, step by step
+                    for b in group:
+                        sharded = self._shard_batch(b)
+                        if self._step_flops is None:
+                            # cost analysis via lowering, or via the AOT
+                            # compile the first call would do anyway —
+                            # never an extra one
+                            flops, self._train_step = step_flops_and_fn(
+                                self._train_step, state, sharded,
+                                num_devices=(self.mesh.devices.size
+                                             if self.mesh is not None
+                                             else 1))
+                            self._step_flops = flops or 0.0
+                        state, metrics = self._train_step(state, sharded)
+                self.global_step += len(group)
                 samples_since += batch_size
-                steps_since += 1
+                steps_since += len(group)
                 if first_step:
-                    # the first call paid jit compilation; keep it out
-                    # of the throughput/MFU measurement window
+                    # the first dispatch paid jit compilation; keep it
+                    # out of the throughput/MFU measurement window
                     jax.block_until_ready(metrics)
                     t0, samples_since, steps_since = time.time(), 0, 0
 
-                if self.global_step % cfg.log_every_n_steps == 0 \
-                        or cfg.fast_dev_run:
+                crossed_log = (self.global_step // cfg.log_every_n_steps
+                               > prev_step // cfg.log_every_n_steps)
+                if crossed_log or cfg.fast_dev_run:
                     # async dispatch: sync on the device before taking
                     # dt, else the window measures host dispatch time
                     # and over-reports throughput/MFU

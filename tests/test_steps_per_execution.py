@@ -1,0 +1,75 @@
+"""steps_per_execution: scanned multi-step dispatch vs single-step.
+
+The scanned path must be a pure batching of the classic loop: same
+number of optimizer steps, same rng chain (train_step splits
+``state.rng`` per step whether driven by Python or ``lax.scan``), and
+therefore numerically matching parameters.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from perceiver_tpu.data import MNISTDataModule
+from perceiver_tpu.training import Trainer, TrainerConfig
+
+from tests.test_training import ADAMW, small_image_task
+
+
+def _run(tmp_path, spe, tag, max_steps=-1, max_epochs=1):
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=96, synthetic_test_size=32)
+    trainer = Trainer(
+        small_image_task(), dm,
+        TrainerConfig(max_epochs=max_epochs, max_steps=max_steps,
+                      steps_per_execution=spe,
+                      default_root_dir=str(tmp_path / f"logs_{tag}"),
+                      enable_checkpointing=False, num_sanity_val_steps=0,
+                      log_every_n_steps=2, prefetch_batches=0),
+        optimizer_init=ADAMW)
+    state = trainer.fit()
+    return trainer, state
+
+
+def test_matches_single_step(tmp_path):
+    t1, s1 = _run(tmp_path, 1, "s1")
+    # 96 synthetic samples minus the val split = 5 train batches:
+    # one full group of 3, then 2 trailing single steps
+    t3, s3 = _run(tmp_path, 3, "s3")
+    assert t1.global_step == t3.global_step == 5
+    assert int(s1.step) == int(s3.step) == 5
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        s1.params, s3.params)
+
+
+def test_trailing_partial_group(tmp_path):
+    """5 train batches with spe=4: one full group + 1 single step."""
+    t, s = _run(tmp_path, 4, "s4")
+    assert t.global_step == 5
+    assert int(s.step) == 5
+
+
+def test_max_steps_not_overshot(tmp_path):
+    t, s = _run(tmp_path, 4, "cap", max_steps=5, max_epochs=3)
+    assert t.global_step == 5
+    assert int(s.step) == 5
+
+
+def test_on_virtual_mesh(tmp_path):
+    from perceiver_tpu.parallel import make_mesh
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=64, synthetic_test_size=32)
+    trainer = Trainer(
+        small_image_task(), dm,
+        TrainerConfig(max_epochs=1, steps_per_execution=2,
+                      default_root_dir=str(tmp_path / "logs_mesh"),
+                      enable_checkpointing=False, num_sanity_val_steps=0,
+                      prefetch_batches=0),
+        optimizer_init=ADAMW, mesh=make_mesh(8))
+    state = trainer.fit()
+    assert trainer.global_step == 3
+    assert np.isfinite(
+        float(jax.tree.leaves(state.params)[0].sum()))
