@@ -104,11 +104,12 @@ def mha_apply(params, q, k, v, *, num_heads: int,
         # weight produces the same three output blocks — but a single
         # wider MXU op instead of three skinny ones, which matters for
         # dispatch-bound small-channel configs.
-        w = jnp.concatenate([params[n]["w"] for n in ("q", "k", "v")],
-                            axis=1)
-        b = jnp.concatenate([params[n]["b"] for n in ("q", "k", "v")])
-        qkv = (policy.cast_compute(q) @ policy.cast_param(w)
-               + policy.cast_param(b))
+        packed = {
+            "w": jnp.concatenate([params[n]["w"] for n in ("q", "k", "v")],
+                                 axis=1),
+            "b": jnp.concatenate([params[n]["b"] for n in ("q", "k", "v")]),
+        }
+        qkv = linear_apply(packed, q, policy=policy)
         e = qkv.shape[-1] // 3
         qh, kh, vh = (_split_heads(qkv[..., i * e:(i + 1) * e], num_heads)
                       for i in range(3))

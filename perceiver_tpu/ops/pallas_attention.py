@@ -43,11 +43,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from perceiver_tpu.ops.tiling import round_up as _round_up
+
 from perceiver_tpu.ops.chunked_attention import NEG_INF, chunked_attention
-
-
-def _round_up(n: int, m: int) -> int:
-    return ((n + m - 1) // m) * m
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,

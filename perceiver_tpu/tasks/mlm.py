@@ -63,20 +63,25 @@ class MaskedLanguageModelTask(TaskConfig):
     #             scatter-packed to a static capacity — identical loss
     #             and gradients (zero-weight rows contribute zero), and
     #             the dominant vocab projection shrinks ~1/mask_p×.
+    #   "pallas": packed positions fed to the fully fused Pallas TPU
+    #             kernel (ops/pallas_ce.py) — logits tiles never leave
+    #             VMEM (interpreter mode off-TPU).
     loss_impl: str = "packed"
     ce_chunk_size: int = 8192
     # packed-buffer capacity as a fraction of B·M. None derives
-    # 1.5 × mask_p plus an additive ~6σ Binomial tail margin (computed
-    # at loss time from the actual B·M), so overflow — which silently
-    # drops rows — stays negligible at SMALL batch·seq products too,
-    # not just asymptotically
+    # mask_p plus an additive ~6σ Binomial tail margin (computed at
+    # loss time from the actual B·M): the selected count is
+    # stochastically dominated by Binomial(B·M, mask_p), so overflow —
+    # which silently drops rows — stays negligible at small
+    # batch·seq products too, while the buffer (and its vocab-matmul
+    # cost) tracks the true ~mask_p fraction
     packed_capacity: Optional[float] = None
 
     def __post_init__(self):
-        if self.loss_impl not in ("dense", "fused", "packed"):
+        if self.loss_impl not in ("dense", "fused", "packed", "pallas"):
             raise ValueError(
                 f"unknown loss_impl {self.loss_impl!r}; expected "
-                "'dense', 'fused', or 'packed'")
+                "'dense', 'fused', 'packed', or 'pallas'")
 
     def build(self) -> PerceiverMLM:
         encoder = create_encoder(self, self.vocab_size, self.max_seq_len)
@@ -143,21 +148,29 @@ class MaskedLanguageModelTask(TaskConfig):
         hidden = hidden.reshape(b * l, c)
         labels = labels.reshape(b * l)
         weight = weight.reshape(b * l)
-        if self.loss_impl == "packed":
+        if self.loss_impl in ("packed", "pallas"):
             n = b * l
             if self.packed_capacity is not None:
                 cap = int(n * min(self.packed_capacity, 1.0))
             else:
-                # mean + ~6σ Binomial(n, 1.5·mask_p) tail: the σ term is
+                # mean + ~6σ Binomial(n, mask_p) tail: the σ term is
                 # what keeps overflow negligible when n is small
-                p = 1.5 * self.mask_p
-                cap = int(n * p + 6.0 * (n * p) ** 0.5) + 8
+                p = self.mask_p
+                sigma = (n * p * (1.0 - p)) ** 0.5
+                cap = int(n * p + 6.0 * sigma) + 8
             cap = min(max(cap, 1), n)
             hidden, labels, weight = pack_positions(hidden, labels, weight,
                                                     cap)
         adapter_params = params["decoder"]["output_adapter"]["linear"]
-        loss = fused_linear_cross_entropy(
-            adapter_params, hidden, labels, weight,
-            chunk_size=min(self.ce_chunk_size, hidden.shape[0]),
-            policy=policy)
+        if self.loss_impl == "pallas":
+            from perceiver_tpu.ops.pallas_ce import (
+                pallas_linear_cross_entropy,
+            )
+            loss = pallas_linear_cross_entropy(
+                adapter_params, hidden, labels, weight, policy=policy)
+        else:
+            loss = fused_linear_cross_entropy(
+                adapter_params, hidden, labels, weight,
+                chunk_size=min(self.ce_chunk_size, hidden.shape[0]),
+                policy=policy)
         return loss, {"loss": loss}

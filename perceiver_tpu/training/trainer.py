@@ -380,8 +380,13 @@ class Trainer:
             while True:
                 remaining = (cfg.max_steps - self.global_step
                              if cfg.max_steps > 0 else spe)
+                if remaining <= 0:
+                    # already at/beyond max_steps (e.g. resumed from a
+                    # finished run) — never pull or train another batch
+                    stop = True
+                    break
                 group = list(itertools.islice(batch_iter,
-                                              max(min(spe, remaining), 1)))
+                                              min(spe, remaining)))
                 if not group:
                     break
                 batch_size = sum(len(b["valid"]) for b in group)

@@ -58,6 +58,30 @@ def test_max_steps_not_overshot(tmp_path):
     assert int(s.step) == 5
 
 
+def test_resume_at_max_steps_trains_zero_steps(tmp_path):
+    """Resuming a run already at max_steps must not overtrain."""
+    import os
+
+    from perceiver_tpu.data import MNISTDataModule
+    from perceiver_tpu.training import Trainer, TrainerConfig
+
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=96, synthetic_test_size=32)
+    root = str(tmp_path / "logs_resume")
+    cfg = TrainerConfig(max_steps=3, max_epochs=5,
+                        default_root_dir=root, num_sanity_val_steps=0,
+                        prefetch_batches=0)
+    t1 = Trainer(small_image_task(), dm, cfg, optimizer_init=ADAMW)
+    s1 = t1.fit()
+    assert int(s1.step) == 3
+    ckpt = os.path.join(t1.log_dir, "checkpoints")
+
+    cfg2 = dataclasses.replace(cfg, resume_from_checkpoint=ckpt)
+    t2 = Trainer(small_image_task(), dm, cfg2, optimizer_init=ADAMW)
+    s2 = t2.fit()
+    assert int(s2.step) == 3  # not 4: zero extra optimizer steps
+
+
 def test_on_virtual_mesh(tmp_path):
     from perceiver_tpu.parallel import make_mesh
     dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
