@@ -86,14 +86,14 @@ def cross_attention_layer_apply(params, x_q, x_kv, *, num_heads,
                                 dropout_rate=0.0, rng=None,
                                 deterministic=True,
                                 policy: Policy = DEFAULT_POLICY,
-                                impl=None, kv_chunk_size=1024):
+                                impl=None, kv_chunk_size=1024, spmd=None):
     """Residual(CrossAttention) then Residual(mlp) (model.py:29-33)."""
     k_attn, k_r1, k_r2 = jax.random.split(_rng_or_dummy(rng, deterministic), 3)
     y = cross_attention_apply(
         params["attn"], x_q, x_kv, num_heads=num_heads,
         key_padding_mask=key_padding_mask, attn_mask=attn_mask,
         dropout_rate=dropout_rate, rng=k_attn, deterministic=deterministic,
-        policy=policy, impl=impl, kv_chunk_size=kv_chunk_size)
+        policy=policy, impl=impl, kv_chunk_size=kv_chunk_size, spmd=spmd)
     x = x_q + dropout(y, dropout_rate, rng=k_r1, deterministic=deterministic)
     y = mlp_apply(params["mlp"], x, policy=policy)
     return x + dropout(y, dropout_rate, rng=k_r2, deterministic=deterministic)
@@ -175,6 +175,11 @@ class PerceiverEncoder:
     # latent array always uses the einsum path.
     attention_impl: Optional[str] = None
     kv_chunk_size: int = 1024
+    # For the shard_map sequence-parallel attention impls ("seqpar",
+    # "ring", "ulysses"): (mesh, seq_axis, batch_axis) describing how
+    # the input token axis is laid out across devices. None for the
+    # single-device / pure-GSPMD paths.
+    spmd: Optional[tuple] = None
     # Rematerialize each perceiver layer (cross-attn + self-attn block)
     # on the backward pass: activations inside a layer are recomputed
     # instead of stored, trading FLOPs for HBM — the lever that fits
@@ -214,7 +219,8 @@ class PerceiverEncoder:
             key_padding_mask=pad_mask, attn_mask=attn_mask,
             dropout_rate=self.dropout, rng=k_cross,
             deterministic=deterministic, policy=policy,
-            impl=self.attention_impl, kv_chunk_size=self.kv_chunk_size)
+            impl=self.attention_impl, kv_chunk_size=self.kv_chunk_size,
+            spmd=self.spmd)
         return self_attention_block_apply(
             params["selfs"], latent,
             num_heads=self.num_self_attention_heads,

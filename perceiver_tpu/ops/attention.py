@@ -69,26 +69,35 @@ def _split_heads(x, num_heads: int):
     return x.reshape(b, l, num_heads, e // num_heads)
 
 
+_SPMD_IMPLS = ("seqpar", "ring", "ulysses")
+
+
 def mha_apply(params, q, k, v, *, num_heads: int,
               key_padding_mask=None, attn_mask=None,
               dropout_rate: float = 0.0, rng=None, deterministic: bool = True,
               policy: Policy = DEFAULT_POLICY, impl: Optional[str] = None,
-              kv_chunk_size: int = 1024):
+              kv_chunk_size: int = 1024, spmd=None):
     """Scaled dot-product multi-head attention.
 
     q: (B, Lq, q_dim); k: (B, Lk, k_dim); v: (B, Lk, v_dim).
     key_padding_mask: (B, Lk) bool, True at padding.
     attn_mask: (Lq, Lk) or (B, Lq, Lk); bool (True = masked) or additive.
     impl: None/"einsum" (materialized weights, supports dropout and
-    attn_mask), "chunked" (blockwise lax.scan, O(Lq·chunk) memory), or
-    "flash" (fused Pallas TPU kernel; interpreter mode off-TPU).
+    attn_mask), "chunked" (blockwise lax.scan, O(Lq·chunk) memory),
+    "flash" (fused Pallas TPU kernel; interpreter mode off-TPU), or one
+    of the shard_map sequence-parallel kernels — "seqpar" (q replicated,
+    kv sequence-sharded: the Perceiver cross-attention layout), "ring"
+    (all of q/k/v sequence-sharded, ppermute kv rotation), "ulysses"
+    (all-to-all heads↔sequence re-sharding). The spmd impls require
+    ``spmd=(mesh, seq_axis, batch_axis)`` describing how the token axis
+    is laid out (batch_axis may be None).
     Returns (B, Lq, q_dim).
     """
-    if impl not in (None, "einsum", "chunked", "flash"):
+    if impl not in (None, "einsum", "chunked", "flash", *_SPMD_IMPLS):
         raise ValueError(
             f"unknown attention impl {impl!r}; expected None, 'einsum', "
-            "'chunked', or 'flash'")
-    if impl in ("chunked", "flash"):
+            "'chunked', 'flash', 'seqpar', 'ring', or 'ulysses'")
+    if impl in ("chunked", "flash", *_SPMD_IMPLS):
         if attn_mask is not None:
             raise NotImplementedError(
                 f"impl={impl!r} supports key_padding_mask only, "
@@ -97,6 +106,9 @@ def mha_apply(params, q, k, v, *, num_heads: int,
             raise NotImplementedError(
                 f"impl={impl!r} does not support attention-weight "
                 "dropout; use the einsum impl")
+    if impl in _SPMD_IMPLS and spmd is None:
+        raise ValueError(
+            f"impl={impl!r} needs spmd=(mesh, seq_axis, batch_axis)")
 
     if k is q and v is q:
         # self-attention: pack the three projections into ONE matmul
@@ -122,6 +134,37 @@ def mha_apply(params, q, k, v, *, num_heads: int,
                           num_heads)
 
     head_dim = qh.shape[-1]
+    if impl in _SPMD_IMPLS:
+        import perceiver_tpu.ops.chunked_attention as _ca
+
+        mesh, seq_axis, batch_axis = spmd
+        bias = (_ca.pad_mask_to_bias(key_padding_mask)
+                if key_padding_mask is not None else None)
+        qt, kt, vt = (x.swapaxes(1, 2) for x in (qh, kh, vh))
+        scale = 1.0 / (head_dim ** 0.5)
+        if impl == "seqpar":
+            from perceiver_tpu.parallel.ring_attention import (
+                make_seq_parallel_cross_attention,
+            )
+            f = make_seq_parallel_cross_attention(
+                mesh, seq_axis, batch_axis=batch_axis, scale=scale)
+        elif impl == "ring":
+            from perceiver_tpu.parallel.ring_attention import (
+                make_ring_attention,
+            )
+            f = make_ring_attention(mesh, seq_axis, batch_axis=batch_axis,
+                                    scale=scale)
+        else:
+            from perceiver_tpu.parallel.ulysses import (
+                make_ulysses_attention,
+            )
+            f = make_ulysses_attention(mesh, seq_axis,
+                                       batch_axis=batch_axis, scale=scale)
+        out = f(qt, kt, vt, bias).swapaxes(1, 2)
+        b, lq = out.shape[0], out.shape[1]
+        out = out.reshape(b, lq, num_heads * head_dim)
+        return linear_apply(params["out"], out, policy=policy)
+
     if impl in ("chunked", "flash"):
         import perceiver_tpu.ops.chunked_attention as _ca
         bias = (_ca.pad_mask_to_bias(key_padding_mask)
@@ -190,7 +233,7 @@ def cross_attention_apply(params, x_q, x_kv, *, num_heads: int,
                           deterministic: bool = True,
                           policy: Policy = DEFAULT_POLICY,
                           impl: Optional[str] = None,
-                          kv_chunk_size: int = 1024):
+                          kv_chunk_size: int = 1024, spmd=None):
     """Pre-norm on q AND kv, then MHA (reference model.py:97-99)."""
     xq = layer_norm_apply(params["norm_q"], x_q, policy=policy)
     xkv = layer_norm_apply(params["norm_kv"], x_kv, policy=policy)
@@ -198,7 +241,7 @@ def cross_attention_apply(params, x_q, x_kv, *, num_heads: int,
                      key_padding_mask=key_padding_mask, attn_mask=attn_mask,
                      dropout_rate=dropout_rate, rng=rng,
                      deterministic=deterministic, policy=policy,
-                     impl=impl, kv_chunk_size=kv_chunk_size)
+                     impl=impl, kv_chunk_size=kv_chunk_size, spmd=spmd)
 
 
 def self_attention_init(key, num_channels: int, num_heads: int,

@@ -116,7 +116,11 @@ def seq_parallel_cross_attention(q, k, v, *, axis_name: str,
 
     m, l, acc = fold_block(q, k, v, bias, scale, *_init_stats(b, h, lq, d))
 
-    m_g = jax.lax.pmax(m, axis_name)
+    # The global max is a pure numerical-stability shift — the combined
+    # softmax is invariant to it, so its gradient is exactly zero.
+    # stop_gradient makes that explicit (pmax has no differentiation
+    # rule), keeping the whole combine differentiable for training.
+    m_g = jax.lax.pmax(jax.lax.stop_gradient(m), axis_name)
     corr = jnp.exp(m - m_g)
     l_g = jax.lax.psum(l * corr, axis_name)
     acc_g = jax.lax.psum(acc * corr, axis_name)

@@ -34,10 +34,28 @@ class TaskConfig:
     # rematerialize encoder layers on backward (memory ↔ FLOPs trade
     # for the large configs; see PerceiverEncoder.remat)
     remat: bool = False
+    # encoder cross-attention kernel (PerceiverEncoder.attention_impl):
+    # None/"einsum", "chunked", "flash", or — given a mesh with a "seq"
+    # axis — the shard_map sequence-parallel impls "seqpar"/"ring"/
+    # "ulysses"
+    attention_impl: Optional[str] = None
+    kv_chunk_size: int = 1024
 
     @property
     def latent_shape(self) -> Tuple[int, int]:
         return (self.num_latents, self.num_latent_channels)
+
+    def encoder_spmd(self, mesh) -> Optional[tuple]:
+        """(mesh, seq_axis, batch_axis) for the shard_map attention
+        impls, or None for single-device / pure-GSPMD kernels."""
+        if self.attention_impl not in ("seqpar", "ring", "ulysses"):
+            return None
+        if mesh is None or "seq" not in mesh.axis_names:
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} needs a mesh "
+                "with a 'seq' axis (make_mesh(..., seq_parallel=N)); "
+                f"got {None if mesh is None else mesh.axis_names}")
+        return (mesh, "seq", "data" if "data" in mesh.axis_names else None)
 
 
 def masked_mean(values, mask):

@@ -22,7 +22,7 @@ class ImageClassifierTask(TaskConfig):
     num_classes: int = 10
     num_frequency_bands: int = 32
 
-    def build(self) -> PerceiverIO:
+    def build(self, mesh=None) -> PerceiverIO:
         input_adapter = ImageInputAdapter(
             image_shape=tuple(self.image_shape),
             num_frequency_bands=self.num_frequency_bands)
@@ -38,6 +38,9 @@ class ImageClassifierTask(TaskConfig):
             num_self_attention_layers_per_block=(
                 self.num_encoder_self_attention_layers_per_block),
             dropout=self.dropout,
+            attention_impl=self.attention_impl,
+            kv_chunk_size=self.kv_chunk_size,
+            spmd=self.encoder_spmd(mesh),
             remat=self.remat)
         decoder = PerceiverDecoder(
             output_adapter=output_adapter,

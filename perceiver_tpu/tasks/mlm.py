@@ -30,7 +30,7 @@ from perceiver_tpu.tokenizer import (
 
 
 def create_encoder(cfg: TaskConfig, vocab_size: int,
-                   max_seq_len: int) -> PerceiverEncoder:
+                   max_seq_len: int, mesh=None) -> PerceiverEncoder:
     """Shared MLM/text-classifier encoder builder (lightning.py:186-200)."""
     input_adapter = TextInputAdapter(
         vocab_size=vocab_size, max_seq_len=max_seq_len,
@@ -44,6 +44,9 @@ def create_encoder(cfg: TaskConfig, vocab_size: int,
         num_self_attention_layers_per_block=(
             cfg.num_encoder_self_attention_layers_per_block),
         dropout=cfg.dropout,
+        attention_impl=cfg.attention_impl,
+        kv_chunk_size=cfg.kv_chunk_size,
+        spmd=cfg.encoder_spmd(mesh),
         remat=cfg.remat)
 
 
@@ -83,8 +86,9 @@ class MaskedLanguageModelTask(TaskConfig):
                 f"unknown loss_impl {self.loss_impl!r}; expected "
                 "'dense', 'fused', 'packed', or 'pallas'")
 
-    def build(self) -> PerceiverMLM:
-        encoder = create_encoder(self, self.vocab_size, self.max_seq_len)
+    def build(self, mesh=None) -> PerceiverMLM:
+        encoder = create_encoder(self, self.vocab_size, self.max_seq_len,
+                                 mesh=mesh)
         output_adapter = TextOutputAdapter(
             vocab_size=self.vocab_size, max_seq_len=self.max_seq_len,
             num_output_channels=self.num_latent_channels)

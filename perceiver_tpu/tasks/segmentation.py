@@ -55,7 +55,7 @@ class SegmentationTask(TaskConfig):
     def num_pixels(self) -> int:
         return self.image_shape[0] * self.image_shape[1]
 
-    def build(self) -> PerceiverIO:
+    def build(self, mesh=None) -> PerceiverIO:
         input_adapter = ImageInputAdapter(
             image_shape=tuple(self.image_shape),
             num_frequency_bands=self.num_frequency_bands)
@@ -72,6 +72,9 @@ class SegmentationTask(TaskConfig):
             num_self_attention_layers_per_block=(
                 self.num_encoder_self_attention_layers_per_block),
             dropout=self.dropout,
+            attention_impl=self.attention_impl,
+            kv_chunk_size=self.kv_chunk_size,
+            spmd=self.encoder_spmd(mesh),
             remat=self.remat)
         chunk = self.query_chunk_size
         if chunk is not None and self.num_pixels % chunk != 0:
@@ -153,7 +156,8 @@ class UResNetSegmentationTask:
     inplanes: int = 16
     background_weight: float = 0.0
 
-    def build(self):
+    def build(self, mesh=None):
+        del mesh  # dense conv net: GSPMD batch sharding only
         from perceiver_tpu.models.uresnet import UResNet
         return UResNet(num_classes=self.num_classes,
                        input_channels=self.image_shape[-1],

@@ -24,8 +24,9 @@ class TextClassifierTask(TaskConfig):
     mlm_ckpt: Optional[str] = None
     clf_ckpt: Optional[str] = None
 
-    def build(self) -> PerceiverIO:
-        encoder = create_encoder(self, self.vocab_size, self.max_seq_len)
+    def build(self, mesh=None) -> PerceiverIO:
+        encoder = create_encoder(self, self.vocab_size, self.max_seq_len,
+                                 mesh=mesh)
         output_adapter = ClassificationOutputAdapter(
             num_classes=self.num_classes,
             num_output_channels=self.num_latent_channels)

@@ -119,7 +119,9 @@ class Trainer:
         self.scheduler_init = scheduler_init
         self.mesh = mesh
 
-        self.model = task.build()
+        # the mesh reaches the model builder so tasks can wire the
+        # shard_map sequence-parallel attention impls to its axes
+        self.model = task.build(mesh=mesh)
         self.policy = self.config.policy()
         self.global_step = 0
         self.current_epoch = 0
@@ -130,6 +132,7 @@ class Trainer:
         self._ckpt: Optional[CheckpointHook] = None
         self._train_step = None
         self._train_step_multi = None
+        self._single_step_ran = False
         self._eval_step = None
         self._preempted = False
         # MFU accounting (SURVEY §5 profiling; BASELINE.md north star)
@@ -392,6 +395,11 @@ class Trainer:
                 batch_size = sum(len(b["valid"]) for b in group)
                 prev_step = self.global_step
                 first_step = self._step_flops is None
+                # the single-step fn compiles separately from the
+                # multi-step one; its first run must also stay out of
+                # the throughput/MFU measurement window
+                first_single = (spe > 1 and len(group) < spe
+                                and not self._single_step_ran)
                 if len(group) == spe and spe > 1:
                     stacked = {key: np.stack([b[key] for b in group])
                                for key in group[0]}
@@ -418,11 +426,12 @@ class Trainer:
                                              else 1))
                             self._step_flops = flops or 0.0
                         state, metrics = self._train_step(state, sharded)
+                    self._single_step_ran = True
                 self.global_step += len(group)
                 samples_since += batch_size
                 steps_since += len(group)
-                if first_step:
-                    # the first dispatch paid jit compilation; keep it
+                if first_step or first_single:
+                    # this dispatch paid a jit compilation; keep it
                     # out of the throughput/MFU measurement window
                     jax.block_until_ready(metrics)
                     t0, samples_since, steps_since = time.time(), 0, 0
