@@ -42,7 +42,11 @@ def main():
     # host→device dispatch latency, the MaxText steps_per_execution
     # pattern. The host feeds inner_steps distinct batches per call.
     inner_steps = int(os.environ.get("BENCH_INNER_STEPS", "8"))
-    task = MaskedLanguageModelTask(vocab_size=vocab, max_seq_len=seq_len)
+    # "packed" (scatter-pack + chunked fused CE) or "pallas" (fully
+    # fused kernel); see MaskedLanguageModelTask.loss_impl
+    loss_impl = os.environ.get("BENCH_LOSS_IMPL", "packed")
+    task = MaskedLanguageModelTask(vocab_size=vocab, max_seq_len=seq_len,
+                                   loss_impl=loss_impl)
     model = task.build()
     policy = Policy.bf16()
 
@@ -113,6 +117,7 @@ def main():
             "seq_len": seq_len,
             "batch_size": batch_size,
             "inner_steps": inner_steps,
+            "loss_impl": loss_impl,
             "steps_per_sec": round(steps_per_sec, 3),
             "precision": "bf16",
             "mfu": round(util, 4) if util is not None else None,

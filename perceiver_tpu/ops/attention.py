@@ -134,38 +134,7 @@ def mha_apply(params, q, k, v, *, num_heads: int,
                           num_heads)
 
     head_dim = qh.shape[-1]
-    if impl in _SPMD_IMPLS:
-        import perceiver_tpu.ops.chunked_attention as _ca
-
-        mesh, seq_axis, batch_axis = spmd
-        bias = (_ca.pad_mask_to_bias(key_padding_mask)
-                if key_padding_mask is not None else None)
-        qt, kt, vt = (x.swapaxes(1, 2) for x in (qh, kh, vh))
-        scale = 1.0 / (head_dim ** 0.5)
-        if impl == "seqpar":
-            from perceiver_tpu.parallel.ring_attention import (
-                make_seq_parallel_cross_attention,
-            )
-            f = make_seq_parallel_cross_attention(
-                mesh, seq_axis, batch_axis=batch_axis, scale=scale)
-        elif impl == "ring":
-            from perceiver_tpu.parallel.ring_attention import (
-                make_ring_attention,
-            )
-            f = make_ring_attention(mesh, seq_axis, batch_axis=batch_axis,
-                                    scale=scale)
-        else:
-            from perceiver_tpu.parallel.ulysses import (
-                make_ulysses_attention,
-            )
-            f = make_ulysses_attention(mesh, seq_axis,
-                                       batch_axis=batch_axis, scale=scale)
-        out = f(qt, kt, vt, bias).swapaxes(1, 2)
-        b, lq = out.shape[0], out.shape[1]
-        out = out.reshape(b, lq, num_heads * head_dim)
-        return linear_apply(params["out"], out, policy=policy)
-
-    if impl in ("chunked", "flash"):
+    if impl in ("chunked", "flash", *_SPMD_IMPLS):
         import perceiver_tpu.ops.chunked_attention as _ca
         bias = (_ca.pad_mask_to_bias(key_padding_mask)
                 if key_padding_mask is not None else None)
@@ -175,10 +144,30 @@ def mha_apply(params, q, k, v, *, num_heads: int,
         if impl == "chunked":
             out = _ca.chunked_attention(qt, kt, vt, bias=bias, scale=scale,
                                         chunk_size=kv_chunk_size)
-        else:
+        elif impl == "flash":
             import perceiver_tpu.ops.pallas_attention as _pa
             out = _pa.flash_attention(qt, kt, vt, bias=bias, scale=scale,
                                       block_k=kv_chunk_size)
+        else:
+            from perceiver_tpu.parallel.ring_attention import (
+                make_ring_attention,
+                make_seq_parallel_cross_attention,
+            )
+            from perceiver_tpu.parallel.ulysses import (
+                make_ulysses_attention,
+            )
+            mesh, seq_axis, batch_axis = spmd
+            if impl == "seqpar":
+                f = make_seq_parallel_cross_attention(
+                    mesh, seq_axis, batch_axis=batch_axis, scale=scale)
+            elif impl == "ring":
+                f = make_ring_attention(mesh, seq_axis,
+                                        batch_axis=batch_axis, scale=scale)
+            else:
+                f = make_ulysses_attention(
+                    mesh, seq_axis, batch_axis=batch_axis, scale=scale,
+                    kv_chunk_size=kv_chunk_size)
+            out = f(qt, kt, vt, bias)
         out = out.swapaxes(1, 2)
         b, lq = out.shape[0], out.shape[1]
         out = out.reshape(b, lq, num_heads * head_dim)

@@ -1,43 +1,52 @@
 #!/usr/bin/env python
-"""Batch-size sweep for the headline MLM benchmark.
+"""Config sweep for the headline MLM benchmark.
 
-Runs ``bench.py`` once per batch size in a fresh process (the TPU
-runtime holds device state per process) and prints a table. Used to
-pick the default ``batch_size`` baked into ``bench.py``; tokens/sec is
-the metric, so batch size is a free parameter (BASELINE.md).
+Runs ``bench.py`` once per (batch, inner_steps, loss_impl) point in a
+fresh process (the TPU runtime holds device state per process) and
+prints a table. Used to pick the defaults baked into ``bench.py``;
+tokens/sec is the metric, so these are free parameters (BASELINE.md).
+
+Usage: bench_sweep.py [batch ...]   (sweeps impls/inner at each batch)
+Env:   SWEEP_IMPLS=packed,pallas  SWEEP_INNER=1,8
 """
 
+import itertools
 import json
 import os
 import subprocess
 import sys
 
-BATCHES = [int(b) for b in (sys.argv[1:] or [64, 128, 256, 512])]
+BATCHES = [int(b) for b in (sys.argv[1:] or [128, 256, 512, 1024])]
+IMPLS = os.environ.get("SWEEP_IMPLS", "packed,pallas").split(",")
+INNER = [int(i) for i in os.environ.get("SWEEP_INNER", "8").split(",")]
 
 ROOT = os.path.join(os.path.dirname(__file__), "..")
 
 best = None
-for b in BATCHES:
-    env = dict(os.environ, BENCH_BATCH=str(b))
+for b, impl, inner in itertools.product(BATCHES, IMPLS, INNER):
+    env = dict(os.environ, BENCH_BATCH=str(b), BENCH_LOSS_IMPL=impl,
+               BENCH_INNER_STEPS=str(inner))
+    tag = f"batch {b:5d} {impl:6s} inner {inner:2d}"
     try:
         out = subprocess.run(
             [sys.executable, os.path.join(ROOT, "bench.py")],
             env=env, capture_output=True, text=True, timeout=900)
         if out.returncode != 0:
             tail = "\n".join(out.stderr.splitlines()[-4:])
-            print(f"batch {b:5d}: FAILED rc={out.returncode}\n{tail}")
+            print(f"{tag}: FAILED rc={out.returncode}\n{tail}")
             continue
         line = [ln for ln in out.stdout.splitlines()
                 if ln.startswith("{")][-1]
         r = json.loads(line)
         tps = r["value"]
-        print(f"batch {b:5d}: {tps:12.1f} tokens/s  "
+        print(f"{tag}: {tps:12.1f} tokens/s  "
               f"mfu={r['detail'].get('mfu')}  "
               f"step={1000 / r['detail']['steps_per_sec']:.1f} ms")
         if best is None or tps > best[1]:
-            best = (b, tps)
+            best = ((b, impl, inner), tps)
     except Exception as e:  # noqa: BLE001 — report and keep sweeping
-        print(f"batch {b:5d}: FAILED ({e})")
+        print(f"{tag}: FAILED ({e})")
 
 if best:
-    print(f"\nbest: batch {best[0]} at {best[1]:.1f} tokens/s")
+    (b, impl, inner), tps = best
+    print(f"\nbest: batch {b} {impl} inner {inner} at {tps:.1f} tokens/s")
