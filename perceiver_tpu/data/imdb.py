@@ -49,12 +49,10 @@ class Collator:
         tokenizer.enable_truncation(max_seq_len)
 
     def collate(self, labels, texts: List[str]):
-        self.tokenizer.no_padding()  # fixed-width padding done here
-        encs = [self.tokenizer.encode(t) for t in texts]
-        ids = np.full((len(encs), self.max_seq_len), PAD_TOKEN_ID,
-                      dtype=np.int32)
-        for i, e in enumerate(encs):
-            ids[i, :len(e.ids)] = e.ids
+        # one GIL-free native call tokenizes the whole batch across
+        # C++ threads (padded-matrix batch API)
+        ids, _ = self.tokenizer.encode_batch_padded(
+            texts, self.max_seq_len, pad_id=PAD_TOKEN_ID)
         pad_mask = ids == PAD_TOKEN_ID
         return np.asarray(labels, np.int32), ids, pad_mask
 

@@ -21,10 +21,12 @@
 // then lexicographically smaller pair), so native and fallback engines
 // produce identical vocabularies.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <map>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -132,6 +134,228 @@ int32_t wp_encode_words(void* vp, const char* words, int32_t unk_id,
         p = nl + 1;
     }
     return total;
+}
+
+// Parallel document-batch encode into a padded (n_docs, max_len)
+// row-major matrix. Each document is a '\n'-joined pre-tokenized word
+// list spanning bytes [offsets[d], offsets[d+1]) of payload (length-
+// delimited, so documents may be empty). Per doc, up to max_len ids
+// are written to row d and lengths[d] reports how many — the stream is
+// truncated at max_len, which matches truncate-after-encode semantics
+// because WordPiece emits pieces strictly left to right. Rows are NOT
+// cleared past lengths[d]; callers pre-fill the matrix with the pad
+// id. Documents are split evenly across n_threads std::threads (the
+// vocab hash is read-only); the Python caller drops the GIL for the
+// duration of the call, so this is true multi-core tokenization.
+void wp_encode_docs(void* vp, const char* payload, const int64_t* offsets,
+                    int32_t n_docs, int32_t unk_id, int32_t max_chars,
+                    const char* prefix, int32_t max_len,
+                    int32_t* out, int32_t* lengths, int32_t n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    n_threads = std::min(n_threads, std::max(n_docs, 1));
+
+    auto work = [=](int32_t lo, int32_t hi) {
+        std::string word;
+        std::vector<int32_t> scratch(
+            static_cast<size_t>(max_len) + 256);
+        for (int32_t d = lo; d < hi; ++d) {
+            const char* p = payload + offsets[d];
+            const char* end = payload + offsets[d + 1];
+            int32_t* row = out + static_cast<int64_t>(d) * max_len;
+            int32_t count = 0;
+            while (p < end && count < max_len) {
+                const char* nl = static_cast<const char*>(
+                    memchr(p, '\n', static_cast<size_t>(end - p)));
+                size_t len = static_cast<size_t>((nl ? nl : end) - p);
+                word.assign(p, len);
+                p = nl ? nl + 1 : end;
+                if (word.empty()) continue;
+                for (;;) {
+                    int32_t n = wp_encode_word(
+                        vp, word.c_str(), unk_id, max_chars, prefix,
+                        scratch.data(),
+                        static_cast<int32_t>(scratch.size()));
+                    if (n >= 0) {
+                        int32_t take = std::min(n, max_len - count);
+                        std::copy(scratch.begin(), scratch.begin() + take,
+                                  row + count);
+                        count += take;
+                        break;
+                    }
+                    scratch.resize(scratch.size() * 2);
+                }
+            }
+            lengths[d] = count;
+        }
+    };
+
+    if (n_threads == 1) {
+        work(0, n_docs);
+        return;
+    }
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    int32_t per = (n_docs + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; ++t) {
+        int32_t lo = t * per, hi = std::min(n_docs, lo + per);
+        if (lo >= hi) break;
+        pool.emplace_back(work, lo, hi);
+    }
+    for (auto& th : pool) th.join();
+}
+
+// Full-pipeline parallel encode for ASCII documents: added-special-token
+// matching on the raw text, then per text segment literal Replaces →
+// lowercase → HF-Whitespace word split (\w+|[^\w\s]+ with ASCII \w =
+// [0-9A-Za-z_]) → WordPiece. On pure-ASCII input this is byte-exact
+// with the Python chain (NFD and StripAccents are identities there);
+// the Python caller routes non-ASCII documents through its own
+// normalizer and marks them with offsets[d] == offsets[d+1] here.
+// Output contract matches wp_encode_docs.
+void wp_encode_docs_raw(void* vp, const char* payload,
+                        const int64_t* offsets, int32_t n_docs,
+                        const char** find, const char** repl,
+                        int32_t n_replaces, int32_t lowercase,
+                        const char** special_toks,
+                        const int32_t* special_ids, int32_t n_specials,
+                        int32_t unk_id, int32_t max_chars,
+                        const char* prefix, int32_t max_len,
+                        int32_t* out, int32_t* lengths,
+                        int32_t n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    n_threads = std::min(n_threads, std::max(n_docs, 1));
+
+    std::vector<std::pair<std::string, std::string>> replaces;
+    for (int32_t i = 0; i < n_replaces; ++i)
+        replaces.emplace_back(find[i], repl[i]);
+    std::vector<std::pair<std::string, int32_t>> specials;
+    for (int32_t i = 0; i < n_specials; ++i)
+        specials.emplace_back(special_toks[i], special_ids[i]);
+
+    auto is_word = [](unsigned char c) {
+        return (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+               (c >= 'a' && c <= 'z') || c == '_';
+    };
+    auto is_space = [](unsigned char c) {
+        // Python's \s on ASCII: [ \t\n\r\f\v] plus the C0
+        // separators \x1c-\x1f (FS/GS/RS/US)
+        return c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+               c == '\f' || c == '\v' || (c >= 0x1c && c <= 0x1f);
+    };
+
+    auto work = [&, vp, unk_id, max_chars, max_len](int32_t lo,
+                                                    int32_t hi) {
+        const std::string pref(prefix);
+        std::string seg, word;
+        std::vector<int32_t> scratch(static_cast<size_t>(max_len) + 256);
+
+        auto encode_word_into = [&](const std::string& w, int32_t* row,
+                                    int32_t& count) {
+            for (;;) {
+                int32_t n = wp_encode_word(
+                    vp, w.c_str(), unk_id, max_chars, pref.c_str(),
+                    scratch.data(), static_cast<int32_t>(scratch.size()));
+                if (n >= 0) {
+                    int32_t take = std::min(n, max_len - count);
+                    std::copy(scratch.begin(), scratch.begin() + take,
+                              row + count);
+                    count += take;
+                    return;
+                }
+                scratch.resize(scratch.size() * 2);
+            }
+        };
+
+        // normalize one raw text segment and stream its pieces
+        auto encode_segment = [&](const char* s, size_t len, int32_t* row,
+                                  int32_t& count) {
+            seg.assign(s, len);
+            for (const auto& fr : replaces) {
+                if (fr.first.empty()) continue;
+                size_t pos = 0;
+                while ((pos = seg.find(fr.first, pos))
+                       != std::string::npos) {
+                    seg.replace(pos, fr.first.size(), fr.second);
+                    pos += fr.second.size();
+                }
+            }
+            if (lowercase)
+                for (char& c : seg)
+                    if (c >= 'A' && c <= 'Z') c += 32;
+            size_t i = 0;
+            while (i < seg.size() && count < max_len) {
+                unsigned char c = static_cast<unsigned char>(seg[i]);
+                if (is_space(c)) { ++i; continue; }
+                size_t j = i + 1;
+                if (is_word(c)) {
+                    while (j < seg.size() && is_word(
+                            static_cast<unsigned char>(seg[j]))) ++j;
+                } else {
+                    while (j < seg.size()) {
+                        unsigned char d = static_cast<unsigned char>(
+                            seg[j]);
+                        if (is_word(d) || is_space(d)) break;
+                        ++j;
+                    }
+                }
+                word.assign(seg, i, j - i);
+                encode_word_into(word, row, count);
+                i = j;
+            }
+        };
+
+        for (int32_t d = lo; d < hi; ++d) {
+            const char* p = payload + offsets[d];
+            const char* end = payload + offsets[d + 1];
+            int32_t* row = out + static_cast<int64_t>(d) * max_len;
+            int32_t count = 0;
+            const char* seg_start = p;
+            while (p < end && count < max_len) {
+                int32_t hit = -1;
+                size_t hit_len = 0;
+                for (size_t k = 0; k < specials.size(); ++k) {
+                    const std::string& t = specials[k].first;
+                    if (static_cast<size_t>(end - p) >= t.size() &&
+                        memcmp(p, t.data(), t.size()) == 0) {
+                        hit = static_cast<int32_t>(k);
+                        hit_len = t.size();
+                        break;
+                    }
+                }
+                if (hit >= 0) {
+                    if (p > seg_start)
+                        encode_segment(seg_start,
+                                       static_cast<size_t>(p - seg_start),
+                                       row, count);
+                    if (count < max_len)
+                        row[count++] = specials[hit].second;
+                    p += hit_len;
+                    seg_start = p;
+                } else {
+                    ++p;
+                }
+            }
+            if (seg_start < end && count < max_len)
+                encode_segment(seg_start,
+                               static_cast<size_t>(end - seg_start),
+                               row, count);
+            lengths[d] = count;
+        }
+    };
+
+    if (n_threads == 1) {
+        work(0, n_docs);
+        return;
+    }
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    int32_t per = (n_docs + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; ++t) {
+        int32_t lo = t * per, hi = std::min(n_docs, lo + per);
+        if (lo >= hi) break;
+        pool.emplace_back(work, lo, hi);
+    }
+    for (auto& th : pool) th.join();
 }
 
 // ---------------------------------------------------------------------------

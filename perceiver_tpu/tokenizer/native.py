@@ -35,7 +35,7 @@ def _load() -> ctypes.CDLL:
             try:
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     _SRC, "-o", tmp],
+                     "-pthread", _SRC, "-o", tmp],
                     check=True, capture_output=True)
                 os.replace(tmp, _LIB)
             except subprocess.CalledProcessError as e:
@@ -60,6 +60,25 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
             ctypes.c_int32, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.wp_encode_docs.restype = None
+        lib.wp_encode_docs.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.wp_encode_docs_raw.restype = None
+        lib.wp_encode_docs_raw.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
         lib.wp_train.restype = ctypes.c_void_p  # manual free
         lib.wp_train.argtypes = [
             ctypes.POINTER(ctypes.c_char_p),
@@ -79,6 +98,7 @@ class NativeVocab:
         self._lib = lib
         ordered = sorted(tokenizer.vocab.items(), key=lambda kv: kv[1])
         self._id_map = [i for _, i in ordered]  # dense idx -> real id
+        self._token_to_dense = {t: j for j, (t, _) in enumerate(ordered)}
         toks = (ctypes.c_char_p * len(ordered))(
             *[t.encode("utf-8") for t, _ in ordered])
         self._handle = lib.wp_vocab_create(toks, len(ordered))
@@ -108,6 +128,81 @@ class NativeVocab:
                 self._buf = buf
             id_map = self._id_map
             return [id_map[buf[i]] for i in range(n)]
+
+    def encode_docs_padded(self, docs_words: List[List[str]],
+                           max_len: int, pad_id: int,
+                           n_threads: int = 0):
+        """Encode many pre-tokenized documents into a padded
+        ``(n_docs, max_len)`` int32 matrix (real vocab ids, ``pad_id``
+        past each document's length) plus a lengths vector, with the
+        WordPiece matching split across C++ threads — the GIL is
+        released for the whole call, so this is true multi-core
+        tokenization of the corpus.
+        """
+        import numpy as np
+
+        id_map = np.asarray(self._id_map, np.int32)
+        pad_dense = int(np.nonzero(id_map == pad_id)[0][0])
+        payloads = ["\n".join(ws).encode("utf-8") for ws in docs_words]
+        offsets = np.zeros(len(payloads) + 1, np.int64)
+        np.cumsum([len(p) for p in payloads], out=offsets[1:])
+        blob = b"".join(payloads)
+        out = np.full((len(payloads), max_len), pad_dense, np.int32)
+        lengths = np.zeros(len(payloads), np.int32)
+        if n_threads <= 0:
+            n_threads = min(os.cpu_count() or 1, 16)
+        self._lib.wp_encode_docs(
+            self._handle, blob,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(payloads), self._unk_dense, self._max_chars, self._prefix,
+            max_len, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_threads)
+        return id_map[out], lengths
+
+    def encode_docs_raw(self, texts: List[str], replaces, lowercase: bool,
+                        specials: List[str], max_len: int, pad_id: int,
+                        n_threads: int = 0):
+        """Full-pipeline encode of raw ASCII documents (added-token
+        matching, literal replaces, lowercasing, HF-Whitespace split,
+        WordPiece) entirely inside threaded C++. Every text must be
+        pure ASCII (empty strings are fine and yield empty rows — the
+        caller's hook for routing non-ASCII documents elsewhere).
+        Returns real-id ``(n, max_len)`` matrix + lengths.
+        """
+        import numpy as np
+
+        id_map = np.asarray(self._id_map, np.int32)
+        pad_dense = int(np.nonzero(id_map == pad_id)[0][0])
+        payloads = [t.encode("ascii") for t in texts]
+        offsets = np.zeros(len(payloads) + 1, np.int64)
+        np.cumsum([len(p) for p in payloads], out=offsets[1:])
+        blob = b"".join(payloads)
+
+        find = (ctypes.c_char_p * max(len(replaces), 1))(
+            *[f.encode("ascii") for f, _ in replaces] or [b""])
+        repl = (ctypes.c_char_p * max(len(replaces), 1))(
+            *[r.encode("ascii") for _, r in replaces] or [b""])
+        sp_toks = (ctypes.c_char_p * max(len(specials), 1))(
+            *[s.encode("ascii") for s in specials] or [b""])
+        sp_dense = [self._token_to_dense[t] for t in specials]
+        sp_ids = (ctypes.c_int32 * max(len(specials), 1))(
+            *(sp_dense or [0]))
+
+        out = np.full((len(payloads), max_len), pad_dense, np.int32)
+        lengths = np.zeros(len(payloads), np.int32)
+        if n_threads <= 0:
+            n_threads = min(os.cpu_count() or 1, 16)
+        self._lib.wp_encode_docs_raw(
+            self._handle, blob,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(payloads), find, repl, len(replaces),
+            1 if lowercase else 0, sp_toks, sp_ids, len(specials),
+            self._unk_dense, self._max_chars, self._prefix, max_len,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_threads)
+        return id_map[out], lengths
 
     def __del__(self):
         try:

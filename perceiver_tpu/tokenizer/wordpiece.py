@@ -268,6 +268,114 @@ class WordPieceTokenizer:
             out.append(text[last:])
         return out
 
+    def _ascii_raw_chain(self):
+        """``(replaces, lowercase)`` when the normalizer chain can run
+        byte-exactly in C++ for ASCII input: leading literal ASCII
+        ``Replace``s followed only by NFD / Lowercase / StripAccents
+        (identity / tolower on ASCII). None when the chain has custom
+        normalizers — those documents take the Python path.
+        """
+        replaces = []
+        tail = list(self.normalizers)
+        while tail and isinstance(tail[0], Replace):
+            r = tail.pop(0)
+            # empty pattern: str.replace('', c) interleaves c between
+            # every character — not reproduced natively, so fall back
+            if not r.pattern or not (r.pattern.isascii()
+                                     and r.content.isascii()):
+                return None
+            replaces.append((r.pattern, r.content))
+        if not all(isinstance(n, (NFD, Lowercase, StripAccents))
+                   for n in tail):
+            return None
+        return replaces, any(isinstance(n, Lowercase) for n in tail)
+
+    def encode_batch_padded(self, texts: Sequence[str], max_len: int,
+                            pad_id: int = PAD_TOKEN_ID):
+        """Corpus-scale batch encode → ``(ids, lengths)`` where ``ids``
+        is a padded ``(n, max_len)`` int32 matrix (truncated at
+        ``max_len``, ``pad_id`` beyond each row's length).
+
+        Semantics match ``encode`` exactly (added-token matching before
+        normalization, then normalize → pre-tokenize → WordPiece), but
+        the WordPiece matching for ALL documents runs in one GIL-free
+        native call across C++ threads — and when the normalizer chain
+        is the factory layout (literal Replaces then NFD/Lowercase/
+        StripAccents) the WHOLE pipeline for ASCII documents runs in
+        C++ (NFD and StripAccents are identities on ASCII), with only
+        non-ASCII documents taking the Python normalizer. Falls back to
+        the per-document Python path off-native.
+        """
+        import numpy as np
+
+        # an enable_truncation limit below max_len caps every row the
+        # same way encode() would — on BOTH the native and Python paths
+        cap = (min(max_len, self._truncation)
+               if self._truncation is not None else max_len)
+
+        nv = self._native_vocab()
+        chain = self._ascii_raw_chain()
+        if nv is not None and chain is not None:
+            replaces, lowercase = chain
+            ascii_ok = [t.isascii() for t in texts]
+            ids, lengths = nv.encode_docs_raw(
+                [t if ok else "" for t, ok in zip(texts, ascii_ok)],
+                replaces, lowercase,
+                [t for t in SPECIAL_TOKENS if t in self.vocab],
+                cap, pad_id)
+            if cap < max_len:
+                ids = np.pad(ids, ((0, 0), (0, max_len - cap)),
+                             constant_values=pad_id)
+            for d, ok in enumerate(ascii_ok):
+                if ok:
+                    continue
+                row = self.encode(texts[d]).ids[:cap]
+                ids[d, :] = pad_id
+                ids[d, :len(row)] = row
+                lengths[d] = len(row)
+            return ids, lengths
+
+        pattern = self._added_token_re()
+        docs: List[List[str]] = []
+        for text in texts:
+            words: List[str] = []
+            segments = ([text] if pattern is None
+                        else self._split_on_added(text, pattern))
+            for seg in segments:
+                if seg in self.vocab and pattern is not None \
+                        and pattern.fullmatch(seg):
+                    # special tokens are vocab entries, so the native
+                    # longest-match resolves them to their own id
+                    words.append(seg)
+                else:
+                    words.extend(self.pre_tokenize(self.normalize(seg)))
+            docs.append(words)
+
+        if nv is not None:
+            ids, lengths = nv.encode_docs_padded(docs, cap, pad_id)
+            if cap < max_len:
+                ids = np.pad(ids, ((0, 0), (0, max_len - cap)),
+                             constant_values=pad_id)
+            return ids, lengths
+
+        ids = np.full((len(docs), max_len), pad_id, np.int32)
+        lengths = np.zeros(len(docs), np.int32)
+        for d, words in enumerate(docs):
+            row: List[int] = []
+            for word in words:
+                if len(row) >= cap:
+                    break
+                if word in self.vocab and pattern is not None \
+                        and pattern.fullmatch(word):
+                    row.append(self.vocab[word])
+                else:
+                    row.extend(self.vocab[t]
+                               for t in self._encode_word(word))
+            row = row[:cap]
+            ids[d, :len(row)] = row
+            lengths[d] = len(row)
+        return ids, lengths
+
     def encode_batch(self, texts: Sequence[str]) -> List[Encoding]:
         encs = [self.encode(t) for t in texts]
         if self._padding is not None and encs:

@@ -183,3 +183,111 @@ def test_trainer_with_replace_normalizer():
     train_tokenizer(tok, corpus, vocab_size=40)
     enc = tok.encode("hello<br />world")
     assert tok.decode(enc.ids) == "hello world"
+
+
+class TestBatchPaddedEncode:
+    """encode_batch_padded: native threaded path vs per-doc encode."""
+
+    TEXTS = [
+        "This movie was [MASK] and I loved it!",
+        "Cafe au lait, naive fiancee — clichéd résumé...",
+        "",
+        "UPPER lower MiXeD 123 #@!  " * 30,  # long doc: truncation
+        "[MASK][MASK] double mask, and [UNK] literal",
+    ]
+
+    def _reference_rows(self, tok, max_len):
+        import numpy as np
+        rows = np.zeros((len(self.TEXTS), max_len), np.int32)
+        lens = []
+        for i, t in enumerate(self.TEXTS):
+            ids = tok.encode(t).ids[:max_len]
+            rows[i, :len(ids)] = ids
+            lens.append(len(ids))
+        return rows, lens
+
+    def test_matches_per_doc_encode(self):
+        import numpy as np
+        from perceiver_tpu.tokenizer import WordPieceTokenizer
+
+        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok.no_truncation()
+        max_len = 64
+        ids, lengths = tok.encode_batch_padded(self.TEXTS, max_len)
+        ref, ref_lens = self._reference_rows(tok, max_len)
+        np.testing.assert_array_equal(lengths, ref_lens)
+        for i, n in enumerate(ref_lens):
+            np.testing.assert_array_equal(ids[i, :n], ref[i, :n])
+            assert (ids[i, n:] == 0).all()  # PAD id 0 past length
+
+    def test_python_fallback_identical(self):
+        import numpy as np
+        from perceiver_tpu.tokenizer import WordPieceTokenizer
+
+        tok = WordPieceTokenizer.from_file(SHIPPED)
+        native_ids, native_lens = tok.encode_batch_padded(self.TEXTS, 48)
+        tok._native_failed = True  # force the pure-Python path
+        py_ids, py_lens = tok.encode_batch_padded(self.TEXTS, 48)
+        np.testing.assert_array_equal(native_ids, py_ids)
+        np.testing.assert_array_equal(native_lens, py_lens)
+
+    def test_many_docs_many_threads(self):
+        import numpy as np
+        from perceiver_tpu.tokenizer import WordPieceTokenizer
+
+        tok = WordPieceTokenizer.from_file(SHIPPED)
+        docs = [f"doc number {i}: some repeated filler text." * (i % 7)
+                for i in range(257)]
+        ids, lengths = tok.encode_batch_padded(docs, 32)
+        assert ids.shape == (257, 32)
+        # spot-check rows against single encodes
+        for i in (0, 1, 100, 256):
+            ref = tok.encode(docs[i]).ids[:32]
+            np.testing.assert_array_equal(ids[i, :len(ref)], ref)
+            assert lengths[i] == len(ref)
+
+    def test_unsupported_chain_falls_back(self):
+        """A non-ASCII Replace disables the raw C++ path but results
+        stay identical to per-doc encode."""
+        import numpy as np
+        from perceiver_tpu.tokenizer import WordPieceTokenizer
+        from perceiver_tpu.tokenizer.wordpiece import Replace
+
+        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok.normalizers.insert(0, Replace("—", " "))
+        assert tok._ascii_raw_chain() is None
+        ids, lengths = tok.encode_batch_padded(self.TEXTS, 48)
+        for i, t in enumerate(self.TEXTS):
+            ref = tok.encode(t).ids[:48]
+            np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
+
+    def test_c0_separator_whitespace_parity(self):
+        """\\x1c-\\x1f are whitespace to Python's \\s — the native raw
+        path must agree."""
+        import numpy as np
+        from perceiver_tpu.tokenizer import WordPieceTokenizer
+
+        tok = WordPieceTokenizer.from_file(SHIPPED)
+        texts = ["a\x1cb", "one\x1dtwo\x1ethree\x1ffour", "tab\tok"]
+        ids, lengths = tok.encode_batch_padded(texts, 16)
+        for i, t in enumerate(texts):
+            ref = tok.encode(t).ids[:16]
+            np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
+
+    def test_truncation_limit_respected(self):
+        """enable_truncation below max_len caps every row identically
+        on the native and fallback paths."""
+        import numpy as np
+        from perceiver_tpu.tokenizer import WordPieceTokenizer
+
+        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok.enable_truncation(5)
+        texts = ["a long sentence with many words here",
+                 "short café text with some accents okay"]
+        ids, lengths = tok.encode_batch_padded(texts, 16)
+        assert ids.shape == (2, 16)
+        assert (lengths <= 5).all()
+        for i, t in enumerate(texts):
+            ref = tok.encode(t).ids  # encode() applies the same cap
+            np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
+            assert (ids[i, lengths[i]:] == 0).all()
