@@ -69,19 +69,33 @@ void* wp_vocab_create(const char** tokens, int32_t n) {
 
 void wp_vocab_free(void* v) { delete static_cast<Vocab*>(v); }
 
+// Length-aware core so batch callers can pass words containing any
+// byte (including NUL — a c-string round-trip would truncate them and
+// silently diverge from the pure-Python engine).
+static int32_t encode_word_impl(const Vocab& v, const std::string& w,
+                                int32_t unk_id, int32_t max_chars,
+                                const std::string& pref,
+                                int32_t* out, int32_t cap);
+
 // Encode one pre-tokenized word. Appends piece ids to out (capacity cap);
 // returns the number of ids written, or -1 if cap was insufficient.
 int32_t wp_encode_word(void* vp, const char* word, int32_t unk_id,
                        int32_t max_chars, const char* prefix,
                        int32_t* out, int32_t cap) {
-    const Vocab& v = *static_cast<Vocab*>(vp);
-    std::string w(word);
+    return encode_word_impl(*static_cast<Vocab*>(vp), std::string(word),
+                            unk_id, max_chars, std::string(prefix), out,
+                            cap);
+}
+
+static int32_t encode_word_impl(const Vocab& v, const std::string& w,
+                                int32_t unk_id, int32_t max_chars,
+                                const std::string& pref,
+                                int32_t* out, int32_t cap) {
     if (utf8_len(w) > static_cast<size_t>(max_chars)) {
         if (cap < 1) return -1;
         out[0] = unk_id;
         return 1;
     }
-    const std::string pref(prefix);
     int32_t count = 0;
     size_t start = 0;
     std::string candidate;
@@ -115,23 +129,29 @@ int32_t wp_encode_word(void* vp, const char* word, int32_t unk_id,
 
 // Encode a batch of pre-tokenized words, '\n'-joined, in one call —
 // per-word FFI round-trips cost more than the WordPiece matching itself.
-// Returns the number of ids written, or -1 if cap was insufficient.
-int32_t wp_encode_words(void* vp, const char* words, int32_t unk_id,
-                        int32_t max_chars, const char* prefix,
-                        int32_t* out, int32_t cap) {
+// Length-delimited (words may contain any byte except '\n', including
+// NUL). Returns the number of ids written, or -1 if cap was
+// insufficient.
+int32_t wp_encode_words(void* vp, const char* words, int64_t words_len,
+                        int32_t unk_id, int32_t max_chars,
+                        const char* prefix, int32_t* out, int32_t cap) {
+    const Vocab& v = *static_cast<Vocab*>(vp);
+    const std::string pref(prefix);
     int32_t total = 0;
     const char* p = words;
+    const char* end = words + words_len;
     std::string word;
-    while (*p) {
-        const char* nl = strchr(p, '\n');
-        size_t len = nl ? static_cast<size_t>(nl - p) : strlen(p);
+    while (p < end) {
+        const char* nl = static_cast<const char*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        size_t len = static_cast<size_t>((nl ? nl : end) - p);
         word.assign(p, len);
-        int32_t n = wp_encode_word(vp, word.c_str(), unk_id, max_chars,
-                                   prefix, out + total, cap - total);
+        p = nl ? nl + 1 : end;
+        if (word.empty()) continue;
+        int32_t n = encode_word_impl(v, word, unk_id, max_chars, pref,
+                                     out + total, cap - total);
         if (n < 0) return -1;
         total += n;
-        if (!nl) break;
-        p = nl + 1;
     }
     return total;
 }
@@ -155,6 +175,7 @@ void wp_encode_docs(void* vp, const char* payload, const int64_t* offsets,
     n_threads = std::min(n_threads, std::max(n_docs, 1));
 
     auto work = [=](int32_t lo, int32_t hi) {
+        const std::string pref(prefix);
         std::string word;
         std::vector<int32_t> scratch(
             static_cast<size_t>(max_len) + 256);
@@ -171,9 +192,9 @@ void wp_encode_docs(void* vp, const char* payload, const int64_t* offsets,
                 p = nl ? nl + 1 : end;
                 if (word.empty()) continue;
                 for (;;) {
-                    int32_t n = wp_encode_word(
-                        vp, word.c_str(), unk_id, max_chars, prefix,
-                        scratch.data(),
+                    int32_t n = encode_word_impl(
+                        *static_cast<Vocab*>(vp), word, unk_id, max_chars,
+                        pref, scratch.data(),
                         static_cast<int32_t>(scratch.size()));
                     if (n >= 0) {
                         int32_t take = std::min(n, max_len - count);
@@ -252,8 +273,8 @@ void wp_encode_docs_raw(void* vp, const char* payload,
         auto encode_word_into = [&](const std::string& w, int32_t* row,
                                     int32_t& count) {
             for (;;) {
-                int32_t n = wp_encode_word(
-                    vp, w.c_str(), unk_id, max_chars, pref.c_str(),
+                int32_t n = encode_word_impl(
+                    *static_cast<Vocab*>(vp), w, unk_id, max_chars, pref,
                     scratch.data(), static_cast<int32_t>(scratch.size()));
                 if (n >= 0) {
                     int32_t take = std::min(n, max_len - count);

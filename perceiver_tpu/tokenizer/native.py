@@ -57,8 +57,8 @@ def _load() -> ctypes.CDLL:
         lib.wp_vocab_free.argtypes = [ctypes.c_void_p]
         lib.wp_encode_words.restype = ctypes.c_int32
         lib.wp_encode_words.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
         lib.wp_encode_docs.restype = None
         lib.wp_encode_docs.argtypes = [
@@ -97,7 +97,9 @@ class NativeVocab:
         lib = _load()
         self._lib = lib
         ordered = sorted(tokenizer.vocab.items(), key=lambda kv: kv[1])
+        import numpy as np
         self._id_map = [i for _, i in ordered]  # dense idx -> real id
+        self._id_map_np = np.asarray(self._id_map, np.int32)
         self._token_to_dense = {t: j for j, (t, _) in enumerate(ordered)}
         toks = (ctypes.c_char_p * len(ordered))(
             *[t.encode("utf-8") for t, _ in ordered])
@@ -120,8 +122,8 @@ class NativeVocab:
             buf = self._buf
             while True:
                 n = self._lib.wp_encode_words(
-                    self._handle, payload, self._unk_dense, self._max_chars,
-                    self._prefix, buf, len(buf))
+                    self._handle, payload, len(payload), self._unk_dense,
+                    self._max_chars, self._prefix, buf, len(buf))
                 if n >= 0:
                     break
                 buf = (ctypes.c_int32 * (len(buf) * 4))()
@@ -141,13 +143,11 @@ class NativeVocab:
         """
         import numpy as np
 
-        id_map = np.asarray(self._id_map, np.int32)
-        pad_dense = int(np.nonzero(id_map == pad_id)[0][0])
         payloads = ["\n".join(ws).encode("utf-8") for ws in docs_words]
         offsets = np.zeros(len(payloads) + 1, np.int64)
         np.cumsum([len(p) for p in payloads], out=offsets[1:])
         blob = b"".join(payloads)
-        out = np.full((len(payloads), max_len), pad_dense, np.int32)
+        out = np.zeros((len(payloads), max_len), np.int32)
         lengths = np.zeros(len(payloads), np.int32)
         if n_threads <= 0:
             n_threads = min(os.cpu_count() or 1, 16)
@@ -158,7 +158,7 @@ class NativeVocab:
             max_len, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             n_threads)
-        return id_map[out], lengths
+        return self._map_and_pad(out, lengths, pad_id), lengths
 
     def encode_docs_raw(self, texts: List[str], replaces, lowercase: bool,
                         specials: List[str], max_len: int, pad_id: int,
@@ -172,8 +172,6 @@ class NativeVocab:
         """
         import numpy as np
 
-        id_map = np.asarray(self._id_map, np.int32)
-        pad_dense = int(np.nonzero(id_map == pad_id)[0][0])
         payloads = [t.encode("ascii") for t in texts]
         offsets = np.zeros(len(payloads) + 1, np.int64)
         np.cumsum([len(p) for p in payloads], out=offsets[1:])
@@ -189,7 +187,7 @@ class NativeVocab:
         sp_ids = (ctypes.c_int32 * max(len(specials), 1))(
             *(sp_dense or [0]))
 
-        out = np.full((len(payloads), max_len), pad_dense, np.int32)
+        out = np.zeros((len(payloads), max_len), np.int32)
         lengths = np.zeros(len(payloads), np.int32)
         if n_threads <= 0:
             n_threads = min(os.cpu_count() or 1, 16)
@@ -202,7 +200,19 @@ class NativeVocab:
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             n_threads)
-        return id_map[out], lengths
+        return self._map_and_pad(out, lengths, pad_id), lengths
+
+    def _map_and_pad(self, dense_out, lengths, pad_id: int):
+        """Dense-id matrix → real ids, with positions past each row's
+        length set to ``pad_id`` — which therefore may be ANY int (e.g.
+        an ignore sentinel), not just a vocab id, matching the
+        pure-Python fallback."""
+        import numpy as np
+
+        real = self._id_map_np[dense_out]
+        cols = np.arange(dense_out.shape[1])
+        real[cols[None, :] >= lengths[:, None]] = pad_id
+        return real
 
     def __del__(self):
         try:

@@ -314,8 +314,19 @@ class WordPieceTokenizer:
                if self._truncation is not None else max_len)
 
         nv = self._native_vocab()
+        if nv is None:
+            # pure-Python engine: one encode() per text — the single
+            # source of truth for per-document semantics
+            ids = np.full((len(texts), max_len), pad_id, np.int32)
+            lengths = np.zeros(len(texts), np.int32)
+            for d, text in enumerate(texts):
+                row = self.encode(text).ids[:cap]
+                ids[d, :len(row)] = row
+                lengths[d] = len(row)
+            return ids, lengths
+
         chain = self._ascii_raw_chain()
-        if nv is not None and chain is not None:
+        if chain is not None:
             replaces, lowercase = chain
             ascii_ok = [t.isascii() for t in texts]
             ids, lengths = nv.encode_docs_raw(
@@ -351,29 +362,10 @@ class WordPieceTokenizer:
                     words.extend(self.pre_tokenize(self.normalize(seg)))
             docs.append(words)
 
-        if nv is not None:
-            ids, lengths = nv.encode_docs_padded(docs, cap, pad_id)
-            if cap < max_len:
-                ids = np.pad(ids, ((0, 0), (0, max_len - cap)),
-                             constant_values=pad_id)
-            return ids, lengths
-
-        ids = np.full((len(docs), max_len), pad_id, np.int32)
-        lengths = np.zeros(len(docs), np.int32)
-        for d, words in enumerate(docs):
-            row: List[int] = []
-            for word in words:
-                if len(row) >= cap:
-                    break
-                if word in self.vocab and pattern is not None \
-                        and pattern.fullmatch(word):
-                    row.append(self.vocab[word])
-                else:
-                    row.extend(self.vocab[t]
-                               for t in self._encode_word(word))
-            row = row[:cap]
-            ids[d, :len(row)] = row
-            lengths[d] = len(row)
+        ids, lengths = nv.encode_docs_padded(docs, cap, pad_id)
+        if cap < max_len:
+            ids = np.pad(ids, ((0, 0), (0, max_len - cap)),
+                         constant_values=pad_id)
         return ids, lengths
 
     def encode_batch(self, texts: Sequence[str]) -> List[Encoding]:

@@ -291,3 +291,28 @@ class TestBatchPaddedEncode:
             ref = tok.encode(t).ids  # encode() applies the same cap
             np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
             assert (ids[i, lengths[i]:] == 0).all()
+
+    def test_nul_byte_parity(self):
+        """Embedded NUL bytes must not truncate native word encoding."""
+        import numpy as np
+        from perceiver_tpu.tokenizer import WordPieceTokenizer
+
+        tok = WordPieceTokenizer.from_file(SHIPPED)
+        texts = [",\x00,", "a\x00b word", "tail nul\x00"]
+        ids, lengths = tok.encode_batch_padded(texts, 16)
+        for i, t in enumerate(texts):
+            ref = tok.encode(t).ids[:16]
+            np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
+
+    def test_non_vocab_pad_id(self):
+        """pad_id outside the vocab (e.g. an ignore sentinel) works on
+        every path."""
+        import numpy as np
+        from perceiver_tpu.tokenizer import WordPieceTokenizer
+
+        tok = WordPieceTokenizer.from_file(SHIPPED)
+        ids, lengths = tok.encode_batch_padded(
+            ["short text", "café au lait"], 12, pad_id=-100)
+        for i in range(2):
+            assert (ids[i, lengths[i]:] == -100).all()
+            assert (ids[i, :lengths[i]] >= 0).all()
