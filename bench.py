@@ -135,10 +135,12 @@ def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
 def main():
     pinned = any(k in os.environ for k in
                  ("BENCH_BATCH", "BENCH_INNER_STEPS", "BENCH_LOSS_IMPL"))
+    top_b, top_inner, top_impl = _LADDER[0]
     if pinned:
-        configs = [(int(os.environ.get("BENCH_BATCH", "256")),
-                    int(os.environ.get("BENCH_INNER_STEPS", "8")),
-                    os.environ.get("BENCH_LOSS_IMPL", "packed"))]
+        configs = [(int(os.environ.get("BENCH_BATCH", str(top_b))),
+                    int(os.environ.get("BENCH_INNER_STEPS",
+                                       str(top_inner))),
+                    os.environ.get("BENCH_LOSS_IMPL", top_impl))]
     else:
         configs = _LADDER
 
@@ -148,10 +150,13 @@ def main():
             print(json.dumps(run(b, inner, impl)))
             return
         except Exception as e:  # noqa: BLE001 — degrade down the ladder
-            last_err = e
+            # keep only the message: holding the exception would pin
+            # the failed run's frames (and its device buffers) alive,
+            # starving the smaller retry configs of the memory the
+            # ladder exists to reclaim
+            last_err = f"{type(e).__name__}: {str(e)[:300]}"
             print(f"bench config (batch={b}, inner={inner}, {impl}) "
-                  f"failed: {type(e).__name__}: {str(e)[:200]}",
-                  file=sys.stderr)
+                  f"failed: {last_err[:220]}", file=sys.stderr)
     raise SystemExit(f"all bench configs failed; last: {last_err}")
 
 

@@ -52,22 +52,47 @@ class BatchIterator:
         self.drop_last = drop_last
         self.transform = transform
         self.epoch = 0
+        self.num_shards = 1
+        self.shard_index = 0
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
 
-    def __len__(self) -> int:
-        n = len(self.dataset)
-        if self.drop_last:
-            return n // self.batch_size
-        return (n + self.batch_size - 1) // self.batch_size
+    def set_sharding(self, num_shards: int, shard_index: int):
+        """Per-host dataset sharding — the DistributedSampler /
+        ``replace_sampler_ddp`` equivalent (reference trainer.yaml:61):
+        every host shuffles with the SAME seed, then takes a strided
+        slice, so the union of hosts covers the epoch exactly once and
+        each host yields the same number of batches (the trailing
+        remainder is dropped — collective step counts must agree).
+        """
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(f"shard {shard_index} not in [0, {num_shards})")
+        self.num_shards = num_shards
+        self.shard_index = shard_index
 
-    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+    def _indices(self) -> np.ndarray:
         n = len(self.dataset)
         idx = np.arange(n)
         if self.shuffle:
             rng = np.random.default_rng((self.seed, self.epoch))
             rng.shuffle(idx)
+        if self.num_shards > 1:
+            per = n // self.num_shards  # equal shards, remainder dropped
+            idx = idx[self.shard_index::self.num_shards][:per]
+        return idx
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.num_shards > 1:
+            n = n // self.num_shards
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        idx = self._indices()
+        n = len(idx)
         bs = self.batch_size
         limit = (n // bs) * bs if self.drop_last else n
         for start in range(0, limit, bs):

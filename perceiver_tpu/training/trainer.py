@@ -184,6 +184,12 @@ class Trainer:
         spec = (jax.sharding.PartitionSpec(None, "data") if stacked
                 else jax.sharding.PartitionSpec("data"))
         sharding = jax.sharding.NamedSharding(self.mesh, spec)
+        if jax.process_count() > 1:
+            # multi-host: each process contributes its per-host shard
+            # (the loaders are process-sharded in _fit); JAX assembles
+            # the global array without any cross-host data movement
+            return {k: jax.make_array_from_process_local_data(sharding, v)
+                    for k, v in batch.items()}
         return {k: jax.device_put(v, sharding) for k, v in batch.items()}
 
     def _make_steps(self):
@@ -268,8 +274,24 @@ class Trainer:
 
     # --- loops ---------------------------------------------------------------
 
+    def _process_shard(self, loader):
+        """Apply per-host dataset sharding on multi-host runs. A loader
+        that cannot shard would silently duplicate data P× (every host
+        contributing identical rows to the global batch), so that is an
+        error, not a fallback."""
+        if jax.process_count() <= 1:
+            return loader
+        if not hasattr(loader, "set_sharding"):
+            raise ValueError(
+                f"multi-host run ({jax.process_count()} processes) needs "
+                "a process-shardable loader (set_sharding); got "
+                f"{type(loader).__name__}")
+        loader.set_sharding(jax.process_count(), jax.process_index())
+        return loader
+
     def _run_eval(self, loader, limit: Optional[int], state: TrainState,
                   prefix: str) -> Dict[str, float]:
+        loader = self._process_shard(loader)
         totals: Dict[str, float] = {}
         count = 0.0
         eval_key = jax.random.key(self.config.seed + 1)
@@ -347,6 +369,9 @@ class Trainer:
             # Lightning semantics: overfit repeats the SAME batches every
             # epoch, so shuffling must be disabled
             train_loader.shuffle = False
+        # per-host data sharding (the DistributedSampler /
+        # replace_sampler_ddp equivalent, reference trainer.yaml:61)
+        train_loader = self._process_shard(train_loader)
         if cfg.prefetch_batches > 0:
             from perceiver_tpu.data.prefetch import PrefetchIterator
             train_loader = PrefetchIterator(train_loader,
