@@ -34,6 +34,34 @@ _LADDER = [
 ]
 
 
+def _log(msg: str) -> None:
+    print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.monotonic()
+
+
+def probe_backend() -> None:
+    """Initialize the backend once, before the ladder.
+
+    Backend bring-up is the single most failure-prone step (a down
+    axon tunnel hangs for many minutes before raising UNAVAILABLE);
+    doing it here means a dead backend fails the bench once, fast and
+    with a clear message, instead of once per ladder config.
+    """
+    import jax
+    # The container's sitecustomize pins the platform via jax.config
+    # (env JAX_PLATFORMS alone is ignored after that) — BENCH_PLATFORM
+    # is the working override, e.g. BENCH_PLATFORM=cpu for smoke runs.
+    want = os.environ.get("BENCH_PLATFORM")
+    if want:
+        jax.config.update("jax_platforms", want)
+    _log("initializing backend ...")
+    devs = jax.devices()
+    _log(f"backend up: {devs}")
+
+
 def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
     import jax
     import jax.numpy as jnp
@@ -90,11 +118,14 @@ def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
     # times, so the dispatch's reported FLOPs already approximate one
     # optimizer step — use as-is (verified on the CPU backend: the
     # number is invariant in inner_steps).
+    _log("tracing + compiling train_steps ...")
     step_flops, train_steps = step_flops_and_fn(train_steps, params,
                                                 opt_state, ids, pad, key)
+    _log("compiled; warming up ...")
     # warmup (compile already done when step_flops_and_fn AOT-compiled)
     params, opt_state, loss = train_steps(params, opt_state, ids, pad, key)
     jax.block_until_ready(loss)
+    _log("warm; timing ...")
 
     n_dispatch = max(20 // inner_steps, 3)
     n_steps = n_dispatch * inner_steps
@@ -144,10 +175,16 @@ def main():
     else:
         configs = _LADDER
 
+    probe_backend()  # fail fast (and once) if no backend comes up
+
     last_err = None
     for i, (b, inner, impl) in enumerate(configs):
+        _log(f"config {i + 1}/{len(configs)}: "
+             f"batch={b} inner={inner} loss={impl}")
         try:
-            print(json.dumps(run(b, inner, impl)))
+            result = run(b, inner, impl)
+            _log("done")
+            print(json.dumps(result))
             return
         except Exception as e:  # noqa: BLE001 — degrade down the ladder
             # keep only the message: holding the exception would pin
@@ -155,8 +192,12 @@ def main():
             # starving the smaller retry configs of the memory the
             # ladder exists to reclaim
             last_err = f"{type(e).__name__}: {str(e)[:300]}"
-            print(f"bench config (batch={b}, inner={inner}, {impl}) "
-                  f"failed: {last_err[:220]}", file=sys.stderr)
+            _log(f"config (batch={b}, inner={inner}, {impl}) "
+                 f"failed: {last_err[:220]}")
+            if "UNAVAILABLE" in last_err or "Unable to initialize" in last_err:
+                # dead backend, not resource pressure — smaller configs
+                # would hit the same wall after the same long hang
+                raise SystemExit(f"backend unavailable: {last_err}")
     raise SystemExit(f"all bench configs failed; last: {last_err}")
 
 

@@ -54,50 +54,72 @@ class BatchIterator:
         self.epoch = 0
         self.num_shards = 1
         self.shard_index = 0
+        self.pad_remainder = False
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
 
-    def set_sharding(self, num_shards: int, shard_index: int):
+    def set_sharding(self, num_shards: int, shard_index: int,
+                     pad_remainder: bool = False):
         """Per-host dataset sharding — the DistributedSampler /
         ``replace_sampler_ddp`` equivalent (reference trainer.yaml:61):
         every host shuffles with the SAME seed, then takes a strided
         slice, so the union of hosts covers the epoch exactly once and
-        each host yields the same number of batches (the trailing
-        remainder is dropped — collective step counts must agree).
+        each host yields the same number of batches (collective step
+        counts must agree).
+
+        ``pad_remainder=False`` (training): the trailing remainder is
+        dropped for equal shards. ``pad_remainder=True`` (eval): short
+        shards are padded with invalid rows instead, so every example
+        is evaluated exactly once and metrics stay exact.
         """
         if not 0 <= shard_index < num_shards:
             raise ValueError(f"shard {shard_index} not in [0, {num_shards})")
         self.num_shards = num_shards
         self.shard_index = shard_index
+        self.pad_remainder = pad_remainder
 
-    def _indices(self) -> np.ndarray:
+    def _shard_len(self) -> int:
+        """Per-shard index count (including any remainder padding)."""
+        n = len(self.dataset)
+        if self.num_shards <= 1:
+            return n
+        if self.pad_remainder:
+            return -(-n // self.num_shards)
+        return n // self.num_shards
+
+    def _indices(self) -> "tuple[np.ndarray, int]":
+        """Returns ``(indices, n_valid)``; positions >= n_valid are
+        remainder padding to be masked invalid."""
         n = len(self.dataset)
         idx = np.arange(n)
         if self.shuffle:
             rng = np.random.default_rng((self.seed, self.epoch))
             rng.shuffle(idx)
         if self.num_shards > 1:
-            per = n // self.num_shards  # equal shards, remainder dropped
+            per = self._shard_len()
             idx = idx[self.shard_index::self.num_shards][:per]
-        return idx
+            n_valid = len(idx)
+            if n_valid < per:  # pad_remainder: equal length, masked tail
+                idx = np.concatenate(
+                    [idx, np.zeros(per - n_valid, dtype=idx.dtype)])
+            return idx, n_valid
+        return idx, n
 
     def __len__(self) -> int:
-        n = len(self.dataset)
-        if self.num_shards > 1:
-            n = n // self.num_shards
+        n = self._shard_len()
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        idx = self._indices()
+        idx, n_valid = self._indices()
         n = len(idx)
         bs = self.batch_size
         limit = (n // bs) * bs if self.drop_last else n
         for start in range(0, limit, bs):
             take = idx[start:start + bs]
-            valid = np.ones(len(take), dtype=bool)
+            valid = np.arange(start, start + len(take)) < n_valid
             if len(take) < bs:  # pad final partial batch, mask invalid rows
                 pad = np.zeros(bs - len(take), dtype=idx.dtype)
                 take = np.concatenate([take, pad])

@@ -87,7 +87,10 @@ class TrainerConfig:
     # up by resume_from_checkpoint like any other.
     preempt_checkpoint: bool = True
     seed: int = 42
-    # informational parity flags (mesh decides actual placement)
+    # accelerator selects the JAX platform (see apply_accelerator;
+    # raises at Trainer construction if the selection cannot take).
+    # devices/num_nodes are informational parity flags — the mesh
+    # decides actual placement.
     accelerator: str = "auto"
     devices: Any = "auto"
     num_nodes: int = 1
@@ -96,6 +99,32 @@ class TrainerConfig:
         if str(self.precision) in ("32", "fp32", "32-true"):
             return Policy.fp32()
         return Policy.bf16()
+
+
+def apply_accelerator(accelerator: str) -> None:
+    """``--trainer.accelerator`` (reference README.md:42-43). "auto"
+    and "tpu" keep the environment's default platform (on the axon
+    container the pinned platform IS the TPU); anything else ("cpu",
+    "gpu") selects that backend explicitly. Must run before any device
+    use in this process — the JAX_PLATFORMS env var is read once at
+    startup by the container's sitecustomize, so the config flag is
+    the only override that still works."""
+    acc = str(accelerator).lower()
+    if acc == "auto":
+        return
+    if acc != "tpu":
+        jax.config.update("jax_platforms", acc)
+    # A late update (after the backend initialized) silently no-ops, so
+    # verify the selection actually took rather than trusting the call.
+    # "tpu" keeps the environment default but still verifies a TPU-class
+    # platform actually came up ("axon" is this container's TPU plugin).
+    got = jax.devices()[0].platform
+    ok = got in ("tpu", "axon") if acc == "tpu" else got == acc
+    if not ok:
+        raise RuntimeError(
+            f"--trainer.accelerator={acc} had no effect (running on "
+            f"{got!r}); select the accelerator before any other jax "
+            "device use in this process")
 
 
 def _version_dir(root: str, experiment: str) -> str:
@@ -118,6 +147,8 @@ class Trainer:
         self.optimizer_init = optimizer_init
         self.scheduler_init = scheduler_init
         self.mesh = mesh
+
+        apply_accelerator(self.config.accelerator)
 
         # the mesh reaches the model builder so tasks can wire the
         # shard_map sequence-parallel attention impls to its axes
@@ -274,11 +305,14 @@ class Trainer:
 
     # --- loops ---------------------------------------------------------------
 
-    def _process_shard(self, loader):
+    def _process_shard(self, loader, pad_remainder: bool = False):
         """Apply per-host dataset sharding on multi-host runs. A loader
         that cannot shard would silently duplicate data P× (every host
         contributing identical rows to the global batch), so that is an
-        error, not a fallback."""
+        error, not a fallback. Training drops the cross-host remainder
+        (equal step counts); eval passes ``pad_remainder=True`` so short
+        shards are padded with invalid rows instead and every example
+        is evaluated exactly once."""
         if jax.process_count() <= 1:
             return loader
         if not hasattr(loader, "set_sharding"):
@@ -286,12 +320,13 @@ class Trainer:
                 f"multi-host run ({jax.process_count()} processes) needs "
                 "a process-shardable loader (set_sharding); got "
                 f"{type(loader).__name__}")
-        loader.set_sharding(jax.process_count(), jax.process_index())
+        loader.set_sharding(jax.process_count(), jax.process_index(),
+                            pad_remainder)
         return loader
 
     def _run_eval(self, loader, limit: Optional[int], state: TrainState,
                   prefix: str) -> Dict[str, float]:
-        loader = self._process_shard(loader)
+        loader = self._process_shard(loader, pad_remainder=True)
         totals: Dict[str, float] = {}
         count = 0.0
         eval_key = jax.random.key(self.config.seed + 1)
@@ -417,7 +452,12 @@ class Trainer:
                                               min(spe, remaining)))
                 if not group:
                     break
-                batch_size = sum(len(b["valid"]) for b in group)
+                # local rows × process count = global rows per dispatch
+                # (each host contributes an equal per-host shard to the
+                # global batch), so samples_per_sec reports global
+                # training throughput, consistent with the mfu scalar
+                batch_size = (sum(len(b["valid"]) for b in group)
+                              * jax.process_count())
                 prev_step = self.global_step
                 first_step = self._step_flops is None
                 # the single-step fn compiles separately from the

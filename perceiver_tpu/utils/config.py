@@ -241,6 +241,11 @@ class CLI:
 
     def _build_mesh(self, trainer_cfg: dict):
         import jax
+
+        # platform selection must precede the first jax.devices() call
+        # (it initializes the backend for the whole process)
+        from perceiver_tpu.training.trainer import apply_accelerator
+        apply_accelerator(trainer_cfg.get("accelerator", "auto"))
         devices = jax.devices()
         if len(devices) <= 1:
             return None

@@ -119,3 +119,27 @@ class TestShardedLoader:
         it = BatchIterator(self._ds(), 4)
         with pytest.raises(ValueError):
             it.set_sharding(2, 2)
+
+    def test_pad_remainder_covers_every_example(self):
+        """Eval sharding: no example dropped, equal batch counts."""
+        loaders = []
+        for s in range(3):
+            it = BatchIterator(self._ds(37), 4, shuffle=True, seed=9)
+            it.set_sharding(3, s, pad_remainder=True)
+            loaders.append(it)
+        # every shard yields the same number of batches (lockstep
+        # collectives) even though 37 = 3*12 + 1
+        assert len({len(it) for it in loaders}) == 1
+        assert all(len(list(it)) == len(it) for it in loaders)
+        seen = [np.concatenate([b["x"][b["valid"]] for b in it])
+                for it in loaders]
+        allx = np.concatenate(seen)
+        # exact cover: all 37 examples exactly once
+        assert len(np.unique(allx)) == len(allx) == 37
+
+    def test_pad_remainder_exact_multiple_unpadded(self):
+        it = BatchIterator(self._ds(36), 4, shuffle=False)
+        it.set_sharding(3, 1, pad_remainder=True)
+        batches = list(it)
+        assert len(batches) == len(it) == 3
+        assert all(b["valid"].all() for b in batches)
