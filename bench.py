@@ -13,6 +13,10 @@ when set (pinned exactly — sweeps rely on that); otherwise a ladder of
 configs is tried from most to least aggressive, so an OOM or compile
 failure on a given chip degrades the number instead of producing none.
 
+``BENCH_TASK=img_clf`` switches to the secondary BASELINE.md metric:
+MNIST imgs/sec/chip with the ``scripts/img_clf.py`` model config
+(32×128 latents, 3 layers, 3 self-attn layers/block, 32 bands).
+
 ``vs_baseline`` is null: the reference publishes no throughput numbers
 (BASELINE.json "published": {}).
 """
@@ -62,22 +66,21 @@ def probe_backend() -> None:
     _log(f"backend up: {devs}")
 
 
-def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
+def _bench_train(task, stacked_batch: dict, *, batch_size: int,
+                 inner_steps: int, units_per_step: int, metric: str,
+                 unit: str, detail: dict) -> dict:
+    """Shared measurement core: jit inner_steps optimizer steps into one
+    dispatch (lax.scan), AOT-compile, warm up, time, report."""
     import jax
-    import jax.numpy as jnp
     import optax
 
     from perceiver_tpu.ops.policy import Policy
-    from perceiver_tpu.tasks import MaskedLanguageModelTask
     from perceiver_tpu.utils.flops import (
         device_peak_flops,
         mfu,
         step_flops_and_fn,
     )
 
-    seq_len, vocab = 512, 10003
-    task = MaskedLanguageModelTask(vocab_size=vocab, max_seq_len=seq_len,
-                                   loss_impl=loss_impl)
     model = task.build()
     policy = Policy.bf16()
 
@@ -85,32 +88,27 @@ def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
     tx = optax.adamw(1e-3)
     opt_state = tx.init(params)
 
-    rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(
-        3, vocab, (inner_steps, batch_size, seq_len)), jnp.int32)
-    pad = jnp.zeros((inner_steps, batch_size, seq_len), bool)
-
     @partial(jax.jit, donate_argnums=(0, 1))
-    def train_steps(params, opt_state, ids, pad, rng):
+    def train_steps(params, opt_state, stacked, rng):
         """inner_steps optimizer steps in one dispatch (lax.scan)."""
 
         def one(carry, xs):
             params, opt_state = carry
-            ids_i, pad_i, key_i = xs
+            batch_i, key_i = xs
 
             def loss_fn(p):
                 loss, _ = task.loss_and_metrics(
-                    model, p, {"input_ids": ids_i, "pad_mask": pad_i},
-                    rng=key_i, deterministic=False, policy=policy)
+                    model, p, batch_i, rng=key_i,
+                    deterministic=False, policy=policy)
                 return loss
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = tx.update(grads, opt_state, params)
             return (optax.apply_updates(params, updates), opt_state), loss
 
-        keys = jax.random.split(rng, ids.shape[0])
+        keys = jax.random.split(rng, inner_steps)
         (params, opt_state), losses = jax.lax.scan(
-            one, (params, opt_state), (ids, pad, keys))
+            one, (params, opt_state), (stacked, keys))
         return params, opt_state, losses[-1]
 
     key = jax.random.key(1)
@@ -119,11 +117,12 @@ def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
     # optimizer step — use as-is (verified on the CPU backend: the
     # number is invariant in inner_steps).
     _log("tracing + compiling train_steps ...")
-    step_flops, train_steps = step_flops_and_fn(train_steps, params,
-                                                opt_state, ids, pad, key)
+    step_flops, train_steps = step_flops_and_fn(
+        train_steps, params, opt_state, stacked_batch, key)
     _log("compiled; warming up ...")
     # warmup (compile already done when step_flops_and_fn AOT-compiled)
-    params, opt_state, loss = train_steps(params, opt_state, ids, pad, key)
+    params, opt_state, loss = train_steps(params, opt_state, stacked_batch,
+                                          key)
     jax.block_until_ready(loss)
     _log("warm; timing ...")
 
@@ -132,26 +131,24 @@ def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
     t0 = time.perf_counter()
     for i in range(n_dispatch):
         key = jax.random.fold_in(key, i)
-        params, opt_state, loss = train_steps(params, opt_state, ids, pad,
-                                              key)
+        params, opt_state, loss = train_steps(params, opt_state,
+                                              stacked_batch, key)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
     steps_per_sec = n_steps / dt
-    tokens_per_sec = steps_per_sec * batch_size * seq_len
     util = mfu(step_flops, n_steps, dt,
                peak_flops_per_device=device_peak_flops())
 
     return {
-        "metric": "imdb_mlm_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
+        "metric": metric,
+        "value": round(steps_per_sec * units_per_step, 1),
+        "unit": unit,
         "vs_baseline": None,
         "detail": {
-            "seq_len": seq_len,
+            **detail,
             "batch_size": batch_size,
             "inner_steps": inner_steps,
-            "loss_impl": loss_impl,
             "steps_per_sec": round(steps_per_sec, 3),
             "precision": "bf16",
             "mfu": round(util, 4) if util is not None else None,
@@ -161,6 +158,55 @@ def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
             "device": str(jax.devices()[0]),
         },
     }
+
+
+def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
+    import jax.numpy as jnp
+
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    seq_len, vocab = 512, 10003
+    task = MaskedLanguageModelTask(vocab_size=vocab, max_seq_len=seq_len,
+                                   loss_impl=loss_impl)
+    rng = np.random.default_rng(0)
+    stacked = {
+        "input_ids": jnp.asarray(rng.integers(
+            3, vocab, (inner_steps, batch_size, seq_len)), jnp.int32),
+        "pad_mask": jnp.zeros((inner_steps, batch_size, seq_len), bool),
+    }
+    return _bench_train(
+        task, stacked, batch_size=batch_size, inner_steps=inner_steps,
+        units_per_step=batch_size * seq_len,
+        metric="imdb_mlm_tokens_per_sec_per_chip", unit="tokens/s",
+        detail={"seq_len": seq_len, "loss_impl": loss_impl})
+
+
+def run_img(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
+    """Secondary BASELINE.md metric: MNIST imgs/sec/chip with the
+    ``scripts/img_clf.py`` model config (32×128 latents, 3 layers,
+    3 self-attn layers/block, 32 frequency bands)."""
+    import jax.numpy as jnp
+
+    from perceiver_tpu.tasks import ImageClassifierTask
+
+    del loss_impl  # CE over 10 classes; no fused-loss variants
+    task = ImageClassifierTask(
+        image_shape=(28, 28, 1), num_classes=10, num_frequency_bands=32,
+        num_latents=32, num_latent_channels=128, num_encoder_layers=3,
+        num_encoder_self_attention_layers_per_block=3,
+        num_decoder_cross_attention_heads=1)
+    rng = np.random.default_rng(0)
+    stacked = {
+        "image": jnp.asarray(rng.normal(
+            0, 1, (inner_steps, batch_size, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(
+            0, 10, (inner_steps, batch_size)), jnp.int32),
+    }
+    return _bench_train(
+        task, stacked, batch_size=batch_size, inner_steps=inner_steps,
+        units_per_step=batch_size,
+        metric="mnist_imgs_per_sec_per_chip", unit="imgs/s",
+        detail={"image_shape": [28, 28, 1]})
 
 
 def main():
@@ -175,6 +221,17 @@ def main():
     else:
         configs = _LADDER
 
+    runner = run_img if os.environ.get("BENCH_TASK") == "img_clf" else run
+    if runner is run_img:
+        # loss_impl doesn't apply to the classifier — collapse ladder
+        # entries that only differ in it (keep first-seen order)
+        seen, deduped = set(), []
+        for b, inner, _ in configs:
+            if (b, inner) not in seen:
+                seen.add((b, inner))
+                deduped.append((b, inner, "n/a"))
+        configs = deduped
+
     probe_backend()  # fail fast (and once) if no backend comes up
 
     last_err = None
@@ -182,7 +239,7 @@ def main():
         _log(f"config {i + 1}/{len(configs)}: "
              f"batch={b} inner={inner} loss={impl}")
         try:
-            result = run(b, inner, impl)
+            result = runner(b, inner, impl)
             _log("done")
             print(json.dumps(result))
             return
