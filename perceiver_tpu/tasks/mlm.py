@@ -103,14 +103,14 @@ class MaskedLanguageModelTask(TaskConfig):
             num_special_tokens=len(SPECIAL_TOKENS), mask_p=self.mask_p)
         return PerceiverMLM(encoder, decoder, masking)
 
-    def on_validation_epoch_end(self, trainer, state):
-        """Log top-k predictions for the configured masked samples to
-        the TB text plugin (reference ``lightning.py:241-256``)."""
+    def _masked_sample_predictions(self, trainer, state):
+        """Top-k fills for the configured masked samples, or None when
+        there are no samples or the datamodule has no tokenizer."""
         if not self.masked_samples:
-            return
+            return None
         dm = trainer.datamodule
         if getattr(dm, "collator", None) is None:
-            return
+            return None
         from perceiver_tpu.utils.predict import predict_masked_samples
         samples = [s.replace("<MASK>", "[MASK]")
                    for s in self.masked_samples]
@@ -118,10 +118,31 @@ class MaskedLanguageModelTask(TaskConfig):
             samples, dm.collator.encode, dm.tokenizer, trainer.model,
             state.params, num_predictions=self.num_predictions,
             policy=trainer.policy)
-        text = "\n\n".join("  \n".join([s] + ps)
-                           for s, ps in zip(samples, predictions))
+        return list(zip(samples, predictions))
+
+    def on_validation_epoch_end(self, trainer, state):
+        """Log top-k predictions for the configured masked samples to
+        the TB text plugin (reference ``lightning.py:241-256``)."""
+        pairs = self._masked_sample_predictions(trainer, state)
+        if pairs is None:
+            return
+        text = "\n\n".join("  \n".join([s] + ps) for s, ps in pairs)
         trainer.writer.add_text("sample predictions", text,
                                 trainer.global_step)
+
+    def predict(self, trainer, state):
+        """CLI ``predict`` subcommand — the reference's only inference
+        entry (masked-sample top-k fills, ``utils.py:22-43`` / SURVEY
+        §3.5) as a standalone verb: encode ``--model.masked_samples``,
+        run with ``masking=False``, return k fills per sample."""
+        pairs = self._masked_sample_predictions(trainer, state)
+        if pairs is None:
+            raise SystemExit(
+                "predict needs --model.masked_samples and a datamodule "
+                "with a tokenizer (run fit or point --data at one)")
+        # list-of-pairs, not a dict: duplicate / normalization-colliding
+        # samples must each keep their predictions, in request order
+        return [{"sample": s, "predictions": ps} for s, ps in pairs]
 
     def loss_and_metrics(self, model, params, batch, *, rng=None,
                          deterministic: bool = True,

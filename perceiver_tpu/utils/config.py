@@ -89,7 +89,7 @@ class CLI:
     defaults, applies links, instantiates datamodule/task/trainer, runs
     the subcommand, snapshots the effective config."""
 
-    SUBCOMMANDS = ("fit", "validate", "test")
+    SUBCOMMANDS = ("fit", "validate", "test", "predict")
 
     def __init__(self, task_cls, datamodules: Dict[str, type],
                  default_datamodule: Optional[str] = None,
@@ -255,6 +255,18 @@ class CLI:
     # --- run -----------------------------------------------------------------
 
     def run(self):
+        # predict preconditions fail before any heavy work (dataset
+        # prep, param init): it needs a task with a predict path and a
+        # trained checkpoint — random-init "predictions" would be
+        # garbage indistinguishable from real output
+        if self.subcommand == "predict":
+            if not hasattr(self.task_cls, "predict"):
+                raise SystemExit(
+                    f"{self.task_cls.__name__} has no predict path "
+                    "(only the MLM task does)")
+            if not self.config.get("ckpt_path"):
+                raise SystemExit(
+                    "predict requires --ckpt_path=<trained checkpoint>")
         task, datamodule, trainer = self.instantiate()
         self.trainer = trainer
         if self.subcommand == "fit":
@@ -267,9 +279,15 @@ class CLI:
                 from perceiver_tpu.training.checkpoint import restore_params
                 params = restore_params(self.config["ckpt_path"])
                 state = dataclasses.replace(state, params=params)
-            result = (trainer.validate(state) if self.subcommand ==
-                      "validate" else trainer.test(state))
-            print(yaml.safe_dump(result, sort_keys=True))
+            if self.subcommand == "validate":
+                result = trainer.validate(state)
+            elif self.subcommand == "test":
+                result = trainer.test(state)
+            else:  # predict — the reference's only inference entry
+                # (masked-sample top-k fills, SURVEY §3.5)
+                result = trainer.task.predict(trainer, state)
+            print(yaml.safe_dump(result, sort_keys=True,
+                                 allow_unicode=True))
         # config snapshot (reference cli.py:22 save_config_overwrite)
         os.makedirs(trainer.log_dir, exist_ok=True)
         with open(os.path.join(trainer.log_dir, "config.yaml"), "w") as f:
@@ -278,7 +296,7 @@ class CLI:
 
     def _print_help(self):
         print(self.description or "perceiver_tpu CLI")
-        print(f"\nusage: {sys.argv[0]} {{fit,validate,test}} "
+        print(f"\nusage: {sys.argv[0]} {{fit,validate,test,predict}} "
               "[--key=value ...]\n")
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
               "--lr_scheduler.* --experiment NAME --config FILE "

@@ -135,6 +135,13 @@ def test_mlm_task_end_to_end(tmp_path):
     # vocab_size from datamodule side: tokenizer trained+cached
     assert os.path.exists(dm.tokenizer_path)
 
+    # the predict verb (reference §3.5 inference path): top-k fills
+    # per masked sample, in request order
+    result = task.predict(trainer, state)
+    assert [r["sample"] for r in result] == ["i [MASK] this film"]
+    fills = result[0]["predictions"]
+    assert len(fills) == 3 and all(isinstance(f, str) for f in fills)
+
 
 def test_text_classifier_transfer_and_freeze(tmp_path):
     """Transfer recipe (lightning.py:144-152): train MLM briefly, save,
