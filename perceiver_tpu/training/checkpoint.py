@@ -90,10 +90,12 @@ class CheckpointHook:
 
 
 def save_params(path: str, params: Any, hparams: Optional[dict] = None):
-    """One-shot params save (the ``run.py:278-281`` analogue)."""
+    """One-shot params save (the ``run.py:278-281`` analogue).
+    Overwrites like ``torch.save`` — a rerun into the same directory
+    must not crash at the end of training."""
     path = _abs(path)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.join(path, "params"), params)
+        ckptr.save(os.path.join(path, "params"), params, force=True)
     if hparams is not None:
         with open(os.path.join(path, "hparams.json"), "w") as f:
             json.dump(hparams, f, indent=2, default=str)

@@ -267,6 +267,9 @@ class CLI:
             if not self.config.get("ckpt_path"):
                 raise SystemExit(
                     "predict requires --ckpt_path=<trained checkpoint>")
+            if not (self.config.get("model") or {}).get("masked_samples"):
+                raise SystemExit(
+                    "predict requires --model.masked_samples")
         task, datamodule, trainer = self.instantiate()
         self.trainer = trainer
         if self.subcommand == "fit":
@@ -296,7 +299,7 @@ class CLI:
 
     def _print_help(self):
         print(self.description or "perceiver_tpu CLI")
-        print(f"\nusage: {sys.argv[0]} {{fit,validate,test,predict}} "
+        print(f"\nusage: {sys.argv[0]} {{{','.join(self.SUBCOMMANDS)}}} "
               "[--key=value ...]\n")
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
               "--lr_scheduler.* --experiment NAME --config FILE "

@@ -68,6 +68,10 @@ def parse_args():
     p.add_argument("--ckpt-dir", default="ckpt")
     p.add_argument("--precision", default="bf16", choices=["bf16", "32"])
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--accelerator", default="auto",
+                   choices=["auto", "tpu", "cpu", "gpu"],
+                   help="JAX platform (the env-var route is closed by "
+                        "the container's early platform pin)")
     return p.parse_args()
 
 
@@ -77,6 +81,9 @@ def main():
     import jax
     import jax.numpy as jnp
     import optax
+
+    from perceiver_tpu.training.trainer import apply_accelerator
+    apply_accelerator(args.accelerator)
 
     from perceiver_tpu.data.core import BatchIterator
     from perceiver_tpu.data.lartpc import load_lartpc

@@ -160,6 +160,9 @@ def test_text_classifier_transfer_and_freeze(tmp_path):
     mlm_params = mlm_model.init(jax.random.key(0))
     ckpt = str(tmp_path / "mlm_ckpt")
     save_params(ckpt, mlm_params)
+    # overwrite semantics (torch.save analogue): a rerun into the same
+    # directory must not crash
+    save_params(ckpt, mlm_params)
 
     clf_task = TextClassifierTask(
         num_classes=2, vocab_size=150, max_seq_len=32, num_latents=8,
