@@ -126,12 +126,28 @@ class CLI:
                 _set_dotted(flat, k, v)
             config = _deep_merge(config, flat)
 
+        # --config file contents merge below dotted flags so a flag
+        # overrides a preset value regardless of argv order
+        file_over: dict = {}
         cli_over: dict = {}
         i = 1
         while i < len(argv):
             arg = argv[i]
             if not arg.startswith("--"):
                 raise SystemExit(f"Unexpected argument: {arg}")
+            if arg == "--print_config" or arg.startswith("--print_config="):
+                # valueless, `=v`, and space-separated forms all work
+                if "=" in arg:
+                    val = _parse_value(arg.split("=", 1)[1])
+                    i += 1
+                elif i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+                    val = _parse_value(argv[i + 1])
+                    i += 2
+                else:
+                    val = True
+                    i += 1
+                self._print_config_requested = bool(val)
+                continue
             if "=" in arg:
                 key, raw = arg[2:].split("=", 1)
                 i += 1
@@ -143,10 +159,8 @@ class CLI:
                 i += 2
             if key == "config":
                 with open(raw) as f:
-                    cli_over = _deep_merge(cli_over,
-                                           yaml.safe_load(f) or {})
-            elif key == "print_config":
-                self._print_config_requested = True
+                    file_over = _deep_merge(file_over,
+                                            yaml.safe_load(f) or {})
             else:
                 val = _parse_value(raw)
                 if key == "data" and isinstance(val, str):
@@ -154,6 +168,7 @@ class CLI:
                     # --data.* option flags (reference README.md:36)
                     key, val = "data.class_name", val
                 _set_dotted(cli_over, key, val)
+        config = _deep_merge(config, file_over)
         config = _deep_merge(config, cli_over)
 
         # static (parse-time) links — a link only fills values into a
@@ -303,7 +318,7 @@ class CLI:
               "[--key=value ...]\n")
         print("flag groups: --model.* --data.* --trainer.* --optimizer.* "
               "--lr_scheduler.* --experiment NAME --config FILE "
-              "--print_config true")
+              "--print_config")
         print(f"\ndatamodules: {sorted(self.datamodules)}")
         print("\nmodel flags:")
         for f in dataclasses.fields(self.task_cls):

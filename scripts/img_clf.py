@@ -14,7 +14,10 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from perceiver_tpu.data import MNISTDataModule  # noqa: E402
+from perceiver_tpu.data import (  # noqa: E402
+    MNISTDataModule,
+    SyntheticImageDataModule,
+)
 from perceiver_tpu.tasks import ImageClassifierTask  # noqa: E402
 from perceiver_tpu.utils.config import CLI, Link  # noqa: E402
 
@@ -24,7 +27,8 @@ TRAINER_YAML = os.path.join(os.path.dirname(__file__), "trainer.yaml")
 def main(args=None, run=True):
     return CLI(
         ImageClassifierTask,
-        datamodules={"MNISTDataModule": MNISTDataModule},
+        datamodules={"MNISTDataModule": MNISTDataModule,
+                     "SyntheticImageDataModule": SyntheticImageDataModule},
         default_datamodule="MNISTDataModule",
         default_config_files=[TRAINER_YAML],
         defaults={  # reference img_clf.py:14-22
