@@ -46,6 +46,7 @@ class SyntheticImageDataModule:
         self.shuffle = shuffle
         self.seed = seed
         self._splits = {}
+        self._param_cache = {}  # class id → blob parameter tuple
 
     def prepare_data(self):
         pass  # nothing to download — procedural
@@ -69,7 +70,8 @@ class SyntheticImageDataModule:
         many other classes exist."""
         h, w, c = self.image_shape
         out = {}
-        # one generator per distinct class id, seeded by (seed, class)
+        # per-class parameters are constant in (seed, class) — cached
+        # so the input-pipeline hot path doesn't reconstruct RNGs
         uniq, inv = np.unique(labels, return_inverse=True)
         cy = np.empty((len(uniq), _BLOBS))
         cx = np.empty_like(cy)
@@ -77,12 +79,16 @@ class SyntheticImageDataModule:
         sx = np.empty_like(cy)
         amp = np.empty((len(uniq), _BLOBS, c))
         for i, cls in enumerate(uniq):
-            g = np.random.default_rng((self.seed, 13, int(cls)))
-            cy[i] = g.uniform(0.2, 0.8, _BLOBS)
-            cx[i] = g.uniform(0.2, 0.8, _BLOBS)
-            sy[i] = g.uniform(0.08, 0.25, _BLOBS)
-            sx[i] = g.uniform(0.08, 0.25, _BLOBS)
-            amp[i] = g.uniform(0.3, 1.0, (_BLOBS, c))
+            cached = self._param_cache.get(int(cls))
+            if cached is None:
+                g = np.random.default_rng((self.seed, 13, int(cls)))
+                cached = (g.uniform(0.2, 0.8, _BLOBS),
+                          g.uniform(0.2, 0.8, _BLOBS),
+                          g.uniform(0.08, 0.25, _BLOBS),
+                          g.uniform(0.08, 0.25, _BLOBS),
+                          g.uniform(0.3, 1.0, (_BLOBS, c)))
+                self._param_cache[int(cls)] = cached
+            cy[i], cx[i], sy[i], sx[i], amp[i] = cached
         for k, v in (("cy", cy), ("cx", cx), ("sy", sy), ("sx", sx),
                      ("amp", amp)):
             out[k] = v[inv]
@@ -94,7 +100,6 @@ class SyntheticImageDataModule:
         h, w, c = self.image_shape
         b = len(labels)
         p = self._class_params(labels)
-        jrng = np.random.default_rng(int(jitter.sum()) % (2**63))
         # per-example center jitter, deterministic in the example seed
         jy = (jitter[:, None] % 997 / 997.0 - 0.5) * 0.1
         jx = (jitter[:, None] % 1013 / 1013.0 - 0.5) * 0.1
@@ -108,7 +113,13 @@ class SyntheticImageDataModule:
         img = np.einsum("bkh,bkw,bkc->bhwc", ey, ex, p["amp"],
                         optimize=True).astype(np.float32)
         img /= max(1, _BLOBS) * 0.5
-        img += jrng.normal(0, 0.05, (b, h, w, c)).astype(np.float32)
+        # pixel noise seeded per example, so an image is identical
+        # regardless of batch composition / sharding (comparable eval
+        # losses across batch sizes)
+        noise = np.stack([
+            np.random.default_rng((self.seed, 17, int(j)))
+            .normal(0, 0.05, (h, w, c)) for j in jitter])
+        img += noise.astype(np.float32)
         return (img - 0.5) / 0.5  # Normalize(0.5, 0.5) like MNIST
 
     def _transform(self):

@@ -45,6 +45,19 @@ class TaskConfig:
     def latent_shape(self) -> Tuple[int, int]:
         return (self.num_latents, self.num_latent_channels)
 
+    # input fields whose second axis is the token/sequence axis; token
+    # tasks set this so those arrays ride a 'seq' mesh axis when one
+    # exists (class attribute, not a dataclass field)
+    seq_partition_fields = ()
+
+    def batch_partition(self, name: str, ndim: int, mesh) -> tuple:
+        """Mesh axes to shard an input field's post-batch dims over
+        (the batch axis itself is always sharded over 'data')."""
+        if (mesh is not None and "seq" in mesh.axis_names
+                and name in self.seq_partition_fields and ndim >= 2):
+            return ("seq",)
+        return ()
+
     def encoder_spmd(self, mesh) -> Optional[tuple]:
         """(mesh, seq_axis, batch_axis) for the shard_map attention
         impls, or None for single-device / pure-GSPMD kernels."""

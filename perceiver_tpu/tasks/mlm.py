@@ -103,6 +103,11 @@ class MaskedLanguageModelTask(TaskConfig):
             num_special_tokens=len(SPECIAL_TOKENS), mask_p=self.mask_p)
         return PerceiverMLM(encoder, decoder, masking)
 
+    # token arrays ride the 'seq' mesh axis when one exists — GSPMD
+    # (or the shard_map attention impls via encoder_spmd) partitions
+    # the encoder cross-attention over the kv axis
+    seq_partition_fields = ("input_ids", "pad_mask")
+
     def _masked_sample_predictions(self, trainer, state):
         """Top-k fills for the configured masked samples, or None when
         there are no samples or the datamodule has no tokenizer."""

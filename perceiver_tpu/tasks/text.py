@@ -24,6 +24,9 @@ class TextClassifierTask(TaskConfig):
     mlm_ckpt: Optional[str] = None
     clf_ckpt: Optional[str] = None
 
+    # same token layout as the MLM task (shared encoder)
+    seq_partition_fields = ("input_ids", "pad_mask")
+
     def build(self, mesh=None) -> PerceiverIO:
         encoder = create_encoder(self, self.vocab_size, self.max_seq_len,
                                  mesh=mesh)

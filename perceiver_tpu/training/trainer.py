@@ -94,6 +94,13 @@ class TrainerConfig:
     accelerator: str = "auto"
     devices: Any = "auto"
     num_nodes: int = 1
+    # mesh shape knobs (CLI route to make_mesh): the data axis gets
+    # all remaining devices. model_parallel opens the tensor-parallel
+    # axis (v5p-16 config, BASELINE configs[4]); seq_parallel opens
+    # the 'seq' axis for sequence-sharded tokens (pjit GSPMD form, or
+    # the shard_map impls via --model.attention_impl)
+    model_parallel: int = 1
+    seq_parallel: int = 1
 
     def policy(self) -> Policy:
         if str(self.precision) in ("32", "fp32", "32-true"):
@@ -203,25 +210,39 @@ class Trainer:
         state = TrainState.create(params, opt_state, state_rng)
 
         if self.mesh is not None:
-            replicated = jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec())
-            state = jax.device_put(state, replicated)
+            # tensor-parallel meshes shard the weight/moment pytrees
+            # per parallel.sharding rules; without a model axis this
+            # reduces to full replication (P() everywhere)
+            from perceiver_tpu.parallel.sharding import param_sharding
+            state = jax.device_put(state,
+                                   param_sharding(state, self.mesh))
         return state
 
     def _shard_batch(self, batch: Dict[str, np.ndarray], *,
                      stacked: bool = False):
         if self.mesh is None:
             return batch
-        spec = (jax.sharding.PartitionSpec(None, "data") if stacked
-                else jax.sharding.PartitionSpec("data"))
-        sharding = jax.sharding.NamedSharding(self.mesh, spec)
+
+        def sharding_for(name: str, arr) -> jax.sharding.NamedSharding:
+            ndim = arr.ndim - (1 if stacked else 0)
+            extra = ()
+            if hasattr(self.task, "batch_partition"):
+                extra = tuple(self.task.batch_partition(
+                    name, ndim, self.mesh) or ())
+            axes = ("data",) + extra
+            spec = (jax.sharding.PartitionSpec(None, *axes) if stacked
+                    else jax.sharding.PartitionSpec(*axes))
+            return jax.sharding.NamedSharding(self.mesh, spec)
+
         if jax.process_count() > 1:
             # multi-host: each process contributes its per-host shard
             # (the loaders are process-sharded in _fit); JAX assembles
             # the global array without any cross-host data movement
-            return {k: jax.make_array_from_process_local_data(sharding, v)
+            return {k: jax.make_array_from_process_local_data(
+                        sharding_for(k, v), v)
                     for k, v in batch.items()}
-        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+        return {k: jax.device_put(v, sharding_for(k, v))
+                for k, v in batch.items()}
 
     def _make_steps(self):
         task, model, policy = self.task, self.model, self.policy

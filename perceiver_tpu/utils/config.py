@@ -170,6 +170,9 @@ class CLI:
                 _set_dotted(cli_over, key, val)
         config = _deep_merge(config, file_over)
         config = _deep_merge(config, cli_over)
+        # everything the user stated explicitly — via --config file or
+        # dotted flag — must suppress parse-time links equally
+        explicit = _deep_merge(file_over, cli_over)
 
         # static (parse-time) links — a link only fills values into a
         # group the user actually configured (linking OneCycle args into
@@ -182,7 +185,7 @@ class CLI:
                 continue
             val = _get_dotted(config, link.source)
             if val is not None and _get_dotted(
-                    cli_over, link.target) is None:
+                    explicit, link.target) is None:
                 if link.compute_fn:
                     val = link.compute_fn(val)
                 _set_dotted(config, link.target, val)
@@ -261,11 +264,12 @@ class CLI:
         # (it initializes the backend for the whole process)
         from perceiver_tpu.training.trainer import apply_accelerator
         apply_accelerator(trainer_cfg.get("accelerator", "auto"))
-        devices = jax.devices()
-        if len(devices) <= 1:
+        mp = int(trainer_cfg.get("model_parallel", 1) or 1)
+        sp = int(trainer_cfg.get("seq_parallel", 1) or 1)
+        if len(jax.devices()) <= 1 and mp * sp <= 1:
             return None
-        import numpy as np
-        return jax.sharding.Mesh(np.array(devices), ("data",))
+        from perceiver_tpu.parallel import make_mesh
+        return make_mesh(model_parallel=mp, seq_parallel=sp)
 
     # --- run -----------------------------------------------------------------
 

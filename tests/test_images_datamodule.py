@@ -38,6 +38,15 @@ def test_deterministic_per_seed():
     np.testing.assert_array_equal(a["label"], b["label"])
 
 
+def test_example_invariant_to_batch_composition():
+    """The same example must render identically under any batch size /
+    sharding — eval losses stay comparable across loader configs."""
+    full = next(iter(_dm(batch_size=8).val_dataloader()))
+    halves = list(_dm(batch_size=4).val_dataloader())[:2]
+    np.testing.assert_array_equal(
+        full["image"], np.concatenate([h["image"] for h in halves]))
+
+
 def test_classes_are_separable_signal():
     """Same-class images must be closer than cross-class images —
     otherwise the 224×224 recipe would be fitting pure noise."""
@@ -78,3 +87,19 @@ def test_baseline_presets_parse(script, preset):
     data = cli.config.get("data")
     name = data if isinstance(data, str) else data.get("class_name")
     assert name in cli.datamodules
+
+
+def test_config_file_values_suppress_parse_links(tmp_path):
+    """A value pinned in a --config file must survive parse-time links
+    exactly like a dotted CLI flag would (links fill gaps, never
+    overwrite anything the user stated)."""
+    preset = tmp_path / "pin.yaml"
+    preset.write_text(
+        "trainer:\n  max_steps: 100\n"
+        "lr_scheduler:\n  class_path: OneCycleLR\n"
+        "  init_args:\n    total_steps: 5\n    max_lr: 0.5\n")
+    cli = _load_script("mlm").main(
+        args=["fit", "--config", str(preset)], run=False)
+    init = cli.config["lr_scheduler"]["init_args"]
+    assert init["total_steps"] == 5
+    assert init["max_lr"] == 0.5
