@@ -126,6 +126,10 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     jax.block_until_ready(loss)
     _log("warm; timing ...")
 
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        jax.profiler.start_trace(profile_dir)
+
     n_dispatch = max(20 // inner_steps, 3)
     n_steps = n_dispatch * inner_steps
     t0 = time.perf_counter()
@@ -135,6 +139,10 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
                                               stacked_batch, key)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+
+    if profile_dir:
+        jax.profiler.stop_trace()
+        _log(f"profile trace written to {profile_dir}")
 
     steps_per_sec = n_steps / dt
     util = mfu(step_flops, n_steps, dt,

@@ -115,11 +115,12 @@ class SyntheticImageDataModule:
         img /= max(1, _BLOBS) * 0.5
         # pixel noise seeded per example, so an image is identical
         # regardless of batch composition / sharding (comparable eval
-        # losses across batch sizes)
-        noise = np.stack([
-            np.random.default_rng((self.seed, 17, int(j)))
-            .normal(0, 0.05, (h, w, c)) for j in jitter])
-        img += noise.astype(np.float32)
+        # losses across batch sizes); drawn f32 straight into the
+        # output buffer — no float64 intermediates or stack copy
+        for i, j in enumerate(jitter):
+            g = np.random.default_rng((self.seed, 17, int(j)))
+            img[i] += g.standard_normal((h, w, c),
+                                        dtype=np.float32) * 0.05
         return (img - 0.5) / 0.5  # Normalize(0.5, 0.5) like MNIST
 
     def _transform(self):

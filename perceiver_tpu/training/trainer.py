@@ -10,9 +10,12 @@ implemented with the JAX-native mechanism (debug_nans, jax.profiler,
 dtype policy) rather than Lightning plumbing.
 
 The step path is one jitted, donated function over the whole
-``TrainState`` pytree; when a ``jax.sharding.Mesh`` is supplied the
-state is replicated and batches are sharded over the ``data`` axis, so
-the same trainer drives one chip or a pod slice (GSPMD inserts the
+``TrainState`` pytree; when a ``jax.sharding.Mesh`` is supplied,
+params/optimizer moments are laid out per ``parallel.sharding`` rules
+(replicated on a data-only mesh, tensor-sharded when the mesh has a
+``model`` axis) and batches are sharded over ``data`` — plus the
+``seq`` axis for token fields the task nominates — so the same
+trainer drives one chip or a dp×sp×tp pod slice (GSPMD inserts the
 gradient all-reduce — the NCCL-DDP equivalent, SURVEY §2.5).
 """
 
@@ -223,16 +226,16 @@ class Trainer:
         if self.mesh is None:
             return batch
 
+        from perceiver_tpu.parallel.sharding import batch_sharding
+
         def sharding_for(name: str, arr) -> jax.sharding.NamedSharding:
             ndim = arr.ndim - (1 if stacked else 0)
-            extra = ()
-            if hasattr(self.task, "batch_partition"):
-                extra = tuple(self.task.batch_partition(
-                    name, ndim, self.mesh) or ())
-            axes = ("data",) + extra
-            spec = (jax.sharding.PartitionSpec(None, *axes) if stacked
-                    else jax.sharding.PartitionSpec(*axes))
-            return jax.sharding.NamedSharding(self.mesh, spec)
+            extra = tuple(self.task.batch_partition(
+                name, ndim, self.mesh) or ())
+            if stacked:
+                spec = jax.sharding.PartitionSpec(None, "data", *extra)
+                return jax.sharding.NamedSharding(self.mesh, spec)
+            return batch_sharding(self.mesh, extra)
 
         if jax.process_count() > 1:
             # multi-host: each process contributes its per-host shard
