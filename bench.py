@@ -130,19 +130,23 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
 
-    n_dispatch = max(20 // inner_steps, 3)
-    n_steps = n_dispatch * inner_steps
-    t0 = time.perf_counter()
-    for i in range(n_dispatch):
-        key = jax.random.fold_in(key, i)
-        params, opt_state, loss = train_steps(params, opt_state,
-                                              stacked_batch, key)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    if profile_dir:
-        jax.profiler.stop_trace()
-        _log(f"profile trace written to {profile_dir}")
+    try:
+        n_dispatch = max(20 // inner_steps, 3)
+        n_steps = n_dispatch * inner_steps
+        t0 = time.perf_counter()
+        for i in range(n_dispatch):
+            key = jax.random.fold_in(key, i)
+            params, opt_state, loss = train_steps(params, opt_state,
+                                                  stacked_batch, key)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    finally:
+        # always close the trace — a mid-loop OOM must not leave the
+        # profiler open (the next ladder config's start_trace would
+        # fail, destroying the degrade-down-the-ladder fallback)
+        if profile_dir:
+            jax.profiler.stop_trace()
+            _log(f"profile trace written to {profile_dir}")
 
     steps_per_sec = n_steps / dt
     util = mfu(step_flops, n_steps, dt,

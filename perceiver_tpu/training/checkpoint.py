@@ -104,18 +104,35 @@ def save_params(path: str, params: Any, hparams: Optional[dict] = None):
 def restore_params(path: str, template: Any = None) -> Any:
     """Load a params pytree from either a ``save_params`` directory or a
     ``CheckpointHook`` step directory (transfer-learning source,
-    ``lightning.py:144-149``)."""
+    ``lightning.py:144-149``). ``template`` (a params pytree) pins
+    shapes/dtypes for a safe typed restore; without it orbax falls back
+    to the on-disk metadata."""
     path = _abs(path)
-    candidates = [os.path.join(path, "params")]
+    # (checkpoint dir, template shape): save_params stores the bare
+    # params tree; CheckpointHook steps store {params, opt_state, ...}
+    # — only params is restored from those (partial restore)
+    candidates = [(os.path.join(path, "params"), False)]
     if os.path.isdir(path):
         # CheckpointHook layout: <dir>/<step>/default/... → pick best/latest
         steps = sorted(int(d) for d in os.listdir(path) if d.isdigit())
-        candidates += [os.path.join(path, str(s), "default")
+        candidates += [(os.path.join(path, str(s), "default"), True)
                        for s in reversed(steps)]
-    with ocp.StandardCheckpointer() as ckptr:
-        for c in candidates:
-            if os.path.isdir(c):
+    for c, wrapped in candidates:
+        if not os.path.isdir(c):
+            continue
+        if template is not None and wrapped:
+            # hook layout stores {params, opt_state, rng, step}; only
+            # params is wanted (and only its template is available)
+            item = {"params": template}
+            with ocp.PyTreeCheckpointer() as ckptr:
+                got = ckptr.restore(c, args=ocp.args.PyTreeRestore(
+                    item=item,
+                    restore_args=ocp.checkpoint_utils
+                    .construct_restore_args(item),
+                    partial_restore=True))
+        else:
+            with ocp.StandardCheckpointer() as ckptr:
                 got = ckptr.restore(c, template)
-                return got.get("params", got) if isinstance(got, dict) \
-                    else got
+        return got.get("params", got) if isinstance(got, dict) \
+            else got
     raise FileNotFoundError(f"No checkpoint found under {path}")

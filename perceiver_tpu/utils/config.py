@@ -299,7 +299,8 @@ class CLI:
             state = trainer._build_state()
             if self.config.get("ckpt_path"):
                 from perceiver_tpu.training.checkpoint import restore_params
-                params = restore_params(self.config["ckpt_path"])
+                params = restore_params(self.config["ckpt_path"],
+                                        template=state.params)
                 state = dataclasses.replace(state, params=params)
             if self.subcommand == "validate":
                 result = trainer.validate(state)
