@@ -143,10 +143,16 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     finally:
         # always close the trace — a mid-loop OOM must not leave the
         # profiler open (the next ladder config's start_trace would
-        # fail, destroying the degrade-down-the-ladder fallback)
+        # fail, destroying the degrade-down-the-ladder fallback) — and
+        # a failing stop must neither mask the original error nor keep
+        # the session open
         if profile_dir:
-            jax.profiler.stop_trace()
-            _log(f"profile trace written to {profile_dir}")
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                _log(f"stop_trace failed: {e}")
+    if profile_dir:
+        _log(f"profile trace written to {profile_dir}")
 
     steps_per_sec = n_steps / dt
     util = mfu(step_flops, n_steps, dt,

@@ -94,6 +94,11 @@ def test_checkpoint_save_restore_resume(tmp_path):
     restored = restore_params(ckpt_dir)
     np.testing.assert_allclose(np.asarray(restored["encoder"]["latent"]),
                                l1)
+    # typed restore with a params template (the CLI non-fit route):
+    # partial restore of the hook layout, same values, no warnings
+    template = small_image_task().build().init(jax.random.key(1))
+    typed = restore_params(ckpt_dir, template=template)
+    np.testing.assert_allclose(np.asarray(typed["encoder"]["latent"]), l1)
 
 
 def test_tb_event_files_written(tmp_path):
