@@ -149,9 +149,11 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
         if profile_dir:
             try:
                 jax.profiler.stop_trace()
+                _trace_ok = True
             except Exception as e:  # noqa: BLE001
+                _trace_ok = False
                 _log(f"stop_trace failed: {e}")
-    if profile_dir:
+    if profile_dir and _trace_ok:
         _log(f"profile trace written to {profile_dir}")
 
     steps_per_sec = n_steps / dt

@@ -92,8 +92,9 @@ class TrainerConfig:
     seed: int = 42
     # accelerator selects the JAX platform (see apply_accelerator;
     # raises at Trainer construction if the selection cannot take).
-    # devices/num_nodes are informational parity flags — the mesh
-    # decides actual placement.
+    # devices=N limits the CLI-built mesh to the first N devices
+    # (README.md:43 semantics; "auto"/-1 = all). num_nodes is
+    # informational — multi-host topology comes from jax.distributed.
     accelerator: str = "auto"
     devices: Any = "auto"
     num_nodes: int = 1

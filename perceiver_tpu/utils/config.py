@@ -266,10 +266,33 @@ class CLI:
         apply_accelerator(trainer_cfg.get("accelerator", "auto"))
         mp = int(trainer_cfg.get("model_parallel", 1) or 1)
         sp = int(trainer_cfg.get("seq_parallel", 1) or 1)
-        if len(jax.devices()) <= 1 and mp * sp <= 1:
+        # --trainer.devices=N uses the first N devices (reference
+        # README.md:43 semantics); "auto"/-1 → all visible devices.
+        # Anything else fails loudly — silently dropping a device
+        # constraint would change per-device batch sizes unnoticed.
+        dev = trainer_cfg.get("devices", "auto")
+        if isinstance(dev, str) and dev.lstrip("-").isdigit():
+            dev = int(dev)
+        n = None
+        if isinstance(dev, bool) or not (
+                dev in ("auto", -1, None) or
+                (isinstance(dev, int) and dev > 0)):
+            raise SystemExit(
+                f"--trainer.devices={dev!r} not supported: use an int "
+                "count, -1, or auto (device *lists* are not supported; "
+                "the mesh always takes the first N devices)")
+        if isinstance(dev, int) and dev > 0:
+            n = dev
+            if jax.process_count() > 1:
+                raise SystemExit(
+                    "--trainer.devices=N is single-host only (a global "
+                    "mesh over the first N devices would exclude other "
+                    "hosts' chips); on pods, control topology via the "
+                    "TPU runtime / jax.distributed instead")
+        if (n or len(jax.devices())) <= 1 and mp * sp <= 1:
             return None
         from perceiver_tpu.parallel import make_mesh
-        return make_mesh(model_parallel=mp, seq_parallel=sp)
+        return make_mesh(n, model_parallel=mp, seq_parallel=sp)
 
     # --- run -----------------------------------------------------------------
 
