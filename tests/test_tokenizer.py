@@ -185,6 +185,26 @@ def test_trainer_with_replace_normalizer():
     assert tok.decode(enc.ids) == "hello world"
 
 
+def test_trained_json_loads_in_hf_tokenizers(tmp_path):
+    """Byte-compatibility in the hard direction: a tokenizer *we
+    trained and saved* must load in the HF/Rust library and encode
+    identically (so checkpoints/tokenizers made here are portable to
+    reference-stack users)."""
+    rust = pytest.importorskip("tokenizers")
+    corpus = ["the quick brown fox jumps over the lazy dog",
+              "Café naïve RÉSUMÉ!", "the lazy dog sleeps deeply"] * 5
+    tok = create_tokenizer(Replace("<br />", " "))
+    train_tokenizer(tok, corpus, vocab_size=120)
+    path = str(tmp_path / "trained.json")
+    tok.save(path)
+    theirs = rust.Tokenizer.from_file(path)
+    for s in ["the quick fox", "Café<br />dog!", "[MASK] the dog",
+              "unseen wordpieces zzz"]:
+        assert theirs.encode(s).ids == tok.encode(s).ids, s
+        assert theirs.decode(tok.encode(s).ids) == tok.decode(
+            tok.encode(s).ids), s
+
+
 class TestBatchPaddedEncode:
     """encode_batch_padded: native threaded path vs per-doc encode."""
 
