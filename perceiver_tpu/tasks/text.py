@@ -45,10 +45,13 @@ class TextClassifierTask(TaskConfig):
         mlm_ckpt → copy the encoder subtree; clf_ckpt → whole model."""
         from perceiver_tpu.training.checkpoint import restore_params
         if self.mlm_ckpt is not None:
+            # cross-model restore (MLM decoder ≠ classifier decoder):
+            # untyped metadata restore, then take the encoder subtree
             mlm_params = restore_params(self.mlm_ckpt)
             return {**params, "encoder": mlm_params["encoder"]}
         if self.clf_ckpt is not None:
-            return restore_params(self.clf_ckpt)
+            # same model — typed restore against our own params
+            return restore_params(self.clf_ckpt, template=params)
         return params
 
     def frozen_param_labels(self, params):

@@ -192,6 +192,19 @@ def test_text_classifier_transfer_and_freeze(tmp_path):
                    ["query"]))
     assert dec_moved
 
+    # clf_ckpt route (lightning.py:147-149): whole-model typed restore
+    clf_ckpt = str(tmp_path / "clf_ckpt")
+    save_params(clf_ckpt, state.params)
+    clf2 = TextClassifierTask(
+        num_classes=2, vocab_size=150, max_seq_len=32, num_latents=8,
+        num_latent_channels=16, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1, clf_ckpt=clf_ckpt)
+    fresh = clf2.build().init(jax.random.key(7))
+    restored = clf2.restore_pretrained(fresh)
+    np.testing.assert_allclose(
+        np.asarray(restored["decoder"]["query"]),
+        np.asarray(state.params["decoder"]["query"]))
+
 
 def test_trainer_on_virtual_mesh(tmp_path):
     """Data-parallel fit over the 8-device virtual CPU mesh."""
