@@ -1,0 +1,58 @@
+"""Best-effort dataset download (reference ``data/imdb.py:92-94`` /
+torchvision MNIST semantics: fetch when absent, behind the same
+datamodule surface).
+
+Zero-egress environments are first-class: every fetch is wrapped, uses
+a short connect timeout, and returns False on any failure so callers
+fall back (to local files or synthetic data) instead of crashing.
+``PERCEIVER_TPU_OFFLINE=1`` skips attempts entirely.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+
+
+def offline() -> bool:
+    return os.environ.get("PERCEIVER_TPU_OFFLINE", "") not in ("", "0")
+
+
+def fetch(url: str, dest: str, timeout: float = 15.0) -> bool:
+    """Download ``url`` to ``dest`` atomically. False on any failure.
+    The temp name is per-process so concurrent callers (multi-host
+    runs sharing a data_dir) never interleave writes; last finished
+    rename wins, each with a complete file."""
+    if offline():
+        return False
+    tmp = f"{dest}.part.{os.getpid()}"
+    try:
+        import urllib.request
+        with urllib.request.urlopen(url, timeout=timeout) as r, \
+                open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+        os.replace(tmp, dest)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def extract_tgz(path: str, dest_dir: str) -> bool:
+    """Extract a .tar.gz safely (no paths escaping ``dest_dir``).
+    On failure the archive is deleted so the next run re-fetches
+    instead of being stuck on a corrupt cached file."""
+    try:
+        with tarfile.open(path, "r:gz") as tf:
+            tf.extractall(dest_dir, filter="data")
+        return True
+    except Exception:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return False

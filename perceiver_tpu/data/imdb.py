@@ -24,6 +24,7 @@ TPU deviations (deliberate):
 from __future__ import annotations
 
 import os
+import shutil
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -132,8 +133,13 @@ class IMDBDataModule:
 
     @property
     def tokenizer_path(self) -> str:
-        return os.path.join(self.data_dir,
-                            f"imdb-tokenizer-{self.vocab_size}.json")
+        # a tokenizer trained on the synthetic fallback corpus must
+        # never be silently reused for the real one (its vocab would
+        # map real reviews to [UNK]) — the cache name records which
+        # corpus it was trained on
+        tag = "" if os.path.isdir(self.aclimdb_root) else "synthetic-"
+        return os.path.join(
+            self.data_dir, f"imdb-tokenizer-{tag}{self.vocab_size}.json")
 
     def _raw_train(self) -> Tuple[List[str], List[int]]:
         if os.path.isdir(self.aclimdb_root):
@@ -147,11 +153,29 @@ class IMDBDataModule:
         self.synthetic = True
         return _synthetic_reviews(self.synthetic_test_size, self.seed + 1)
 
+    _URL = "https://ai.stanford.edu/~amaas/data/sentiment/aclImdb_v1.tar.gz"
+
     def prepare_data(self):
-        """Train + cache the tokenizer if absent (imdb.py:91-103)."""
+        """Download the corpus if absent (imdb.py:92-94), then train +
+        cache the tokenizer if absent (imdb.py:96-103). Both steps are
+        best-effort offline: no corpus → synthetic reviews."""
+        os.makedirs(self.data_dir, exist_ok=True)
+        if not os.path.isdir(self.aclimdb_root):
+            from perceiver_tpu.data.download import extract_tgz, fetch
+            tgz = os.path.join(self.data_dir, "aclImdb_v1.tar.gz")
+            if os.path.exists(tgz) or fetch(self._URL, tgz):
+                # extract to a temp dir and publish atomically — a
+                # partial tree must never masquerade as the corpus
+                tmp = self.aclimdb_root + ".extract-tmp"
+                shutil.rmtree(tmp, ignore_errors=True)
+                if extract_tgz(tgz, tmp) and \
+                        os.path.isdir(os.path.join(tmp, "aclImdb")):
+                    if not os.path.isdir(self.aclimdb_root):
+                        os.replace(os.path.join(tmp, "aclImdb"),
+                                   self.aclimdb_root)
+                shutil.rmtree(tmp, ignore_errors=True)
         if os.path.exists(self.tokenizer_path):
             return
-        os.makedirs(self.data_dir, exist_ok=True)
         texts, _ = self._raw_train()
         tokenizer = create_tokenizer(Replace("<br />", " "))
         train_tokenizer(tokenizer, texts, vocab_size=self.vocab_size)

@@ -123,8 +123,20 @@ class MNISTDataModule:
     def num_classes(self) -> int:
         return 10
 
+    _MIRROR = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+
     def prepare_data(self):
-        pass  # no download path in this environment (zero egress)
+        """Download IDX files if absent (torchvision-MNIST semantics,
+        same mirror). Best-effort: offline → synthetic digits."""
+        if all(_find_idx(self.data_dir, v) for v in _FILES.values()):
+            return
+        from perceiver_tpu.data.download import fetch
+        os.makedirs(self.data_dir, exist_ok=True)
+        for base in _FILES.values():
+            dest = os.path.join(self.data_dir, base + ".gz")
+            if not os.path.exists(dest):
+                if not fetch(self._MIRROR + base + ".gz", dest):
+                    break  # host unreachable — don't stall 4× timeouts
 
     def setup(self, stage: Optional[str] = None):
         if self._train is not None:

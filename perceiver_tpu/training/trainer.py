@@ -391,12 +391,24 @@ class Trainer:
                               old_term if old_term is not None
                               else signal.SIG_DFL)
 
+    def _prepare_data(self):
+        """Lightning ``prepare_data`` semantics on multi-host: only
+        process 0 downloads/trains-tokenizer (concurrent writers on a
+        shared data_dir would corrupt caches), everyone syncs after."""
+        if jax.process_count() <= 1:
+            self.datamodule.prepare_data()
+            return
+        if jax.process_index() == 0:
+            self.datamodule.prepare_data()
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("prepare_data")
+
     def _fit(self) -> TrainState:
         cfg = self.config
         if cfg.detect_anomaly:
             jax.config.update("jax_debug_nans", True)
 
-        self.datamodule.prepare_data()
+        self._prepare_data()
         self.datamodule.setup()
         self.writer = SummaryWriter(self.log_dir)
         if cfg.enable_checkpointing:

@@ -317,7 +317,7 @@ class CLI:
         if self.subcommand == "fit":
             state = trainer.fit()
         else:
-            trainer.datamodule.prepare_data()
+            trainer._prepare_data()
             trainer.datamodule.setup()
             state = trainer._build_state()
             if self.config.get("ckpt_path"):

@@ -12,6 +12,10 @@ jax import (backend selection happens lazily on first device use).
 
 import os
 
+# never attempt dataset downloads from tests — zero-egress sandboxes
+# can stall on connect timeouts; synthetic fallbacks are the contract
+os.environ.setdefault("PERCEIVER_TPU_OFFLINE", "1")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
