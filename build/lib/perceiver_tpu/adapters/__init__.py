@@ -1,0 +1,9 @@
+"""Task-specific input/output adapters (reference ``perceiver/adapter.py``)."""
+
+from perceiver_tpu.adapters.image import ImageInputAdapter  # noqa: F401
+from perceiver_tpu.adapters.text import TextInputAdapter  # noqa: F401
+from perceiver_tpu.adapters.output import (  # noqa: F401
+    ClassificationOutputAdapter,
+    SemanticSegOutputAdapter,
+    TextOutputAdapter,
+)

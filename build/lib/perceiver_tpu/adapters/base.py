@@ -1,0 +1,37 @@
+"""Adapter interfaces.
+
+Mirror of the reference ABCs (``perceiver/adapter.py:9-32``):
+
+- an input adapter maps raw task input → ``(B, M, num_input_channels)``;
+- an output adapter exposes ``output_shape == (K, C_out)`` which sizes
+  the decoder's learned query array (reference ``model.py:201-204,222``)
+  and maps the decoder's cross-attention output to task output.
+
+Adapters here are frozen dataclasses ("module definitions") with
+``init(key) -> params`` and ``apply(params, x) -> y``; parameters live
+in plain pytrees so they shard/checkpoint like everything else.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Tuple
+
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+class InputAdapter(Protocol):
+    @property
+    def num_input_channels(self) -> int: ...
+
+    def init(self, key): ...
+
+    def apply(self, params, x, *, policy: Policy = DEFAULT_POLICY): ...
+
+
+class OutputAdapter(Protocol):
+    @property
+    def output_shape(self) -> Tuple[int, int]: ...
+
+    def init(self, key): ...
+
+    def apply(self, params, x, *, policy: Policy = DEFAULT_POLICY): ...

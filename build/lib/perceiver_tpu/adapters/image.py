@@ -1,0 +1,68 @@
+"""Image input adapter with Fourier position encodings.
+
+Parity target: reference ``perceiver/adapter.py:35-109``. Raw pixels in
+channels-last layout ``(B, *spatial, C)`` are flattened to
+``(B, prod(spatial), C)`` and concatenated with a precomputed Fourier
+position encoding (see ``perceiver_tpu.ops.fourier``), giving
+``num_input_channels = C + ndim * (2 * num_bands + 1)`` — e.g. MNIST
+28×28×1 with 32 bands → 1 + 2·(2·32+1) = 131 channels.
+
+TPU note: the encoding is a build-time NumPy constant baked into the
+jitted computation; the concat fuses into the first cross-attention
+k/v projection, so the adapter adds no separate HBM pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_tpu.ops.fourier import (
+    fourier_position_encodings,
+    num_fourier_channels,
+)
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageInputAdapter:
+    image_shape: Tuple[int, ...]  # (*spatial, channels), channels-last
+    num_frequency_bands: int
+    max_frequencies: Optional[Tuple[float, ...]] = None
+
+    @property
+    def spatial_shape(self) -> Tuple[int, ...]:
+        return self.image_shape[:-1]
+
+    @property
+    def num_image_channels(self) -> int:
+        return self.image_shape[-1]
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_image_channels + num_fourier_channels(
+            self.spatial_shape, self.num_frequency_bands)
+
+    def position_encoding(self) -> np.ndarray:
+        return fourier_position_encodings(
+            self.spatial_shape, self.num_frequency_bands,
+            max_frequencies=self.max_frequencies)
+
+    def init(self, key):
+        del key  # no learned parameters
+        return {}
+
+    def apply(self, params, x, *, policy: Policy = DEFAULT_POLICY):
+        del params
+        b = x.shape[0]
+        if tuple(x.shape[1:]) != tuple(self.image_shape):
+            raise ValueError(
+                f"Input image shape {tuple(x.shape[1:])} different from "
+                f"required shape {tuple(self.image_shape)}")
+        x = x.reshape(b, -1, self.num_image_channels)
+        enc = jnp.asarray(self.position_encoding(), policy.compute_dtype)
+        enc = jnp.broadcast_to(enc[None], (b, *enc.shape))
+        return jnp.concatenate([policy.cast_compute(x), enc], axis=-1)

@@ -1,0 +1,68 @@
+"""Output adapters: classification, semantic segmentation, text logits.
+
+Parity targets: reference ``perceiver/adapter.py:136-173``.
+
+- ``ClassificationOutputAdapter``: ``output_shape = (num_outputs,
+  num_output_channels)`` with channels defaulting to ``num_classes``;
+  Linear(C_out → classes), squeezing the query axis when there is a
+  single output query (torch's ``squeeze(dim=1)`` is a no-op for
+  ``num_outputs > 1``; here the squeeze is static on shape).
+- ``SemanticSegOutputAdapter``: the reference version constructs a
+  linear layer but returns its input unchanged — a defect
+  (SURVEY.md §2.6.3). This rebuild applies the linear projection, i.e.
+  per-pixel class logits, which is the evident intent.
+- ``TextOutputAdapter``: classification adapter with
+  ``num_classes = vocab_size`` and ``num_outputs = max_seq_len`` →
+  per-position vocab logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from perceiver_tpu.ops.linear import linear_init, linear_apply
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassificationOutputAdapter:
+    num_classes: int
+    num_outputs: int = 1
+    num_output_channels: Optional[int] = None
+
+    def __post_init__(self):
+        if self.num_output_channels is None:
+            object.__setattr__(self, "num_output_channels", self.num_classes)
+
+    @property
+    def output_shape(self) -> Tuple[int, int]:
+        return (self.num_outputs, self.num_output_channels)
+
+    def init(self, key):
+        return {"linear": linear_init(key, self.num_output_channels,
+                                      self.num_classes)}
+
+    def apply(self, params, x, *, policy: Policy = DEFAULT_POLICY):
+        y = linear_apply(params["linear"], x, policy=policy)
+        if self.num_outputs == 1:
+            y = y.squeeze(axis=1)
+        return y
+
+
+@dataclasses.dataclass(frozen=True)
+class SemanticSegOutputAdapter(ClassificationOutputAdapter):
+    """Per-query (per-pixel) class logits; see module docstring re: the
+    reference's identity-forward defect."""
+
+    def apply(self, params, x, *, policy: Policy = DEFAULT_POLICY):
+        return linear_apply(params["linear"], x, policy=policy)
+
+
+def TextOutputAdapter(vocab_size: int, max_seq_len: int,
+                      num_output_channels: Optional[int] = None
+                      ) -> ClassificationOutputAdapter:
+    """Factory matching reference ``adapter.py:166-173``."""
+    return ClassificationOutputAdapter(
+        num_classes=vocab_size, num_outputs=max_seq_len,
+        num_output_channels=num_output_channels)

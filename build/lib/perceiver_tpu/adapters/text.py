@@ -1,0 +1,39 @@
+"""Text input adapter: scaled token embedding + learned positions.
+
+Parity target: reference ``perceiver/adapter.py:112-133`` — token
+embedding with U(-0.1, 0.1) init scaled by sqrt(C), plus a learned
+positional embedding table with U(-0.5, 0.5) init truncated to the
+input sequence length.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.initializers import uniform
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+@dataclasses.dataclass(frozen=True)
+class TextInputAdapter:
+    vocab_size: int
+    max_seq_len: int
+    num_input_channels: int
+
+    def init(self, key):
+        ke, kp = jax.random.split(key)
+        return {
+            "embed": uniform(ke, (self.vocab_size, self.num_input_channels), 0.1),
+            "pos": uniform(kp, (self.max_seq_len, self.num_input_channels), 0.5),
+        }
+
+    def apply(self, params, x, *, policy: Policy = DEFAULT_POLICY):
+        l = x.shape[1]
+        scale = math.sqrt(self.num_input_channels)
+        emb = jnp.take(policy.cast_param(params["embed"]), x, axis=0)
+        pos = policy.cast_param(params["pos"][:l])
+        return emb * jnp.asarray(scale, policy.compute_dtype) + pos[None]

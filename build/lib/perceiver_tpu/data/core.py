@@ -1,0 +1,133 @@
+"""Dataset/batching primitives.
+
+The reference leans on torch ``DataLoader`` worker processes
+(``data/imdb.py:112-126``, ``data/mnist.py:15``). On TPU the input
+pipeline is a host-side NumPy concern: batches are assembled on CPU and
+handed to jitted steps as static-shape arrays. Static shapes are a hard
+requirement — a ragged final batch would trigger recompilation — so
+every batch carries a boolean ``valid`` row mask and the final partial
+batch is padded, letting eval metrics stay exact without dynamic
+shapes (SURVEY §7.4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class ArrayDataset:
+    """A tuple of equal-length arrays with named fields."""
+
+    def __init__(self, **fields: np.ndarray):
+        lengths = {k: len(v) for k, v in fields.items()}
+        if len(set(lengths.values())) > 1:
+            raise ValueError(f"Field length mismatch: {lengths}")
+        self.fields = fields
+        self.length = next(iter(lengths.values())) if lengths else 0
+
+    def __len__(self) -> int:
+        return self.length
+
+    def subset(self, indices: Sequence[int]) -> "ArrayDataset":
+        return ArrayDataset(**{k: v[indices] for k, v in self.fields.items()})
+
+
+class BatchIterator:
+    """Deterministic, epoch-seeded batching over an ArrayDataset.
+
+    Yields dict batches with an extra ``valid`` (B,) bool mask; the
+    final partial batch is zero-padded to the full batch size.
+    """
+
+    def __init__(self, dataset: ArrayDataset, batch_size: int,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = False,
+                 transform=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.transform = transform
+        self.epoch = 0
+        self.num_shards = 1
+        self.shard_index = 0
+        self.pad_remainder = False
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def set_sharding(self, num_shards: int, shard_index: int,
+                     pad_remainder: bool = False):
+        """Per-host dataset sharding — the DistributedSampler /
+        ``replace_sampler_ddp`` equivalent (reference trainer.yaml:61):
+        every host shuffles with the SAME seed, then takes a strided
+        slice, so the union of hosts covers the epoch exactly once and
+        each host yields the same number of batches (collective step
+        counts must agree).
+
+        ``pad_remainder=False`` (training): the trailing remainder is
+        dropped for equal shards. ``pad_remainder=True`` (eval): short
+        shards are padded with invalid rows instead, so every example
+        is evaluated exactly once and metrics stay exact.
+        """
+        if not 0 <= shard_index < num_shards:
+            raise ValueError(f"shard {shard_index} not in [0, {num_shards})")
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+        self.pad_remainder = pad_remainder
+
+    def _shard_len(self) -> int:
+        """Per-shard index count (including any remainder padding)."""
+        n = len(self.dataset)
+        if self.num_shards <= 1:
+            return n
+        if self.pad_remainder:
+            return -(-n // self.num_shards)
+        return n // self.num_shards
+
+    def _indices(self) -> "tuple[np.ndarray, int]":
+        """Returns ``(indices, n_valid)``; positions >= n_valid are
+        remainder padding to be masked invalid."""
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            rng.shuffle(idx)
+        if self.num_shards > 1:
+            per = self._shard_len()
+            idx = idx[self.shard_index::self.num_shards][:per]
+            n_valid = len(idx)
+            if n_valid < per:  # pad_remainder: equal length, masked tail
+                idx = np.concatenate(
+                    [idx, np.zeros(per - n_valid, dtype=idx.dtype)])
+            return idx, n_valid
+        return idx, n
+
+    def __len__(self) -> int:
+        n = self._shard_len()
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        idx, n_valid = self._indices()
+        n = len(idx)
+        bs = self.batch_size
+        limit = (n // bs) * bs if self.drop_last else n
+        for start in range(0, limit, bs):
+            take = idx[start:start + bs]
+            valid = np.arange(start, start + len(take)) < n_valid
+            if len(take) < bs:  # pad final partial batch, mask invalid rows
+                pad = np.zeros(bs - len(take), dtype=idx.dtype)
+                take = np.concatenate([take, pad])
+                valid = np.concatenate(
+                    [valid, np.zeros(bs - len(valid), dtype=bool)])
+            batch = {k: v[take] for k, v in self.dataset.fields.items()}
+            batch["valid"] = valid
+            if self.transform is not None:
+                batch = self.transform(batch, self.epoch,
+                                       start // bs)
+            yield batch

@@ -48,18 +48,7 @@ def extract_tgz(path: str, dest_dir: str) -> bool:
     instead of being stuck on a corrupt cached file."""
     try:
         with tarfile.open(path, "r:gz") as tf:
-            try:
-                tf.extractall(dest_dir, filter="data")
-            except TypeError:
-                # filter= landed in 3.10.12/3.11.4; older patch
-                # releases get a manual traversal check instead
-                base = os.path.realpath(dest_dir)
-                for m in tf.getmembers():
-                    target = os.path.realpath(
-                        os.path.join(dest_dir, m.name))
-                    if not target.startswith(base + os.sep):
-                        raise ValueError(f"unsafe tar member {m.name}")
-                tf.extractall(dest_dir)
+            tf.extractall(dest_dir, filter="data")
         return True
     except Exception:
         try:

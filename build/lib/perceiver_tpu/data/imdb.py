@@ -164,24 +164,16 @@ class IMDBDataModule:
             from perceiver_tpu.data.download import extract_tgz, fetch
             tgz = os.path.join(self.data_dir, "aclImdb_v1.tar.gz")
             if os.path.exists(tgz) or fetch(self._URL, tgz):
-                # extract to a per-process temp dir and publish
-                # atomically — a partial tree must never masquerade as
-                # the corpus, and concurrent extractors never collide
-                tmp = f"{self.aclimdb_root}.extract-tmp.{os.getpid()}"
+                # extract to a temp dir and publish atomically — a
+                # partial tree must never masquerade as the corpus
+                tmp = self.aclimdb_root + ".extract-tmp"
                 shutil.rmtree(tmp, ignore_errors=True)
-                ok = extract_tgz(tgz, tmp) and \
-                    os.path.isdir(os.path.join(tmp, "aclImdb"))
-                if ok and not os.path.isdir(self.aclimdb_root):
-                    os.replace(os.path.join(tmp, "aclImdb"),
-                               self.aclimdb_root)
+                if extract_tgz(tgz, tmp) and \
+                        os.path.isdir(os.path.join(tmp, "aclImdb")):
+                    if not os.path.isdir(self.aclimdb_root):
+                        os.replace(os.path.join(tmp, "aclImdb"),
+                                   self.aclimdb_root)
                 shutil.rmtree(tmp, ignore_errors=True)
-                if not ok:
-                    # a tarball that extracts but has no aclImdb/ root
-                    # (or fails) must not short-circuit future fetches
-                    try:
-                        os.unlink(tgz)
-                    except OSError:
-                        pass
         if os.path.exists(self.tokenizer_path):
             return
         texts, _ = self._raw_train()
@@ -192,13 +184,7 @@ class IMDBDataModule:
     def setup(self, stage: Optional[str] = None):
         if self._train is not None:
             return
-        if not os.path.exists(self.tokenizer_path):
-            # standalone use (no Trainer): make setup self-sufficient —
-            # but ONLY when the tokenizer is missing, so multi-host
-            # runs (where Trainer._prepare_data gated the download to
-            # process 0) don't re-enter the download/train path on
-            # every process
-            self.prepare_data()
+        self.prepare_data()
         self.tokenizer = load_tokenizer(self.tokenizer_path)
         self.collator = Collator(self.tokenizer, self.max_seq_len)
 

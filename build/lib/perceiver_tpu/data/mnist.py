@@ -137,30 +137,18 @@ class MNISTDataModule:
             if not os.path.exists(dest):
                 if not fetch(self._MIRROR + base + ".gz", dest):
                     break  # host unreachable — don't stall 4× timeouts
-                try:
-                    _read_idx(dest)  # validate (captive portals return
-                except Exception:   # HTML with status 200)
-                    os.unlink(dest)
-                    break
 
     def setup(self, stage: Optional[str] = None):
         if self._train is not None:
             return
         paths = {k: _find_idx(self.data_dir, v) for k, v in _FILES.items()}
-        loaded = False
         if all(paths.values()):
-            try:
-                xtr = _read_idx(paths["train_images"])
-                ytr = _read_idx(paths["train_labels"]).astype(np.int32)
-                xte = _read_idx(paths["test_images"])
-                yte = _read_idx(paths["test_labels"]).astype(np.int32)
-                val_split = self.val_split
-                loaded = True
-            except Exception:
-                # corrupt local files → synthetic fallback, never a
-                # crash (module contract)
-                loaded = False
-        if not loaded:
+            xtr = _read_idx(paths["train_images"])
+            ytr = _read_idx(paths["train_labels"]).astype(np.int32)
+            xte = _read_idx(paths["test_images"])
+            yte = _read_idx(paths["test_labels"]).astype(np.int32)
+            val_split = self.val_split
+        else:
             self.synthetic = True
             (xtr, ytr), (xte, yte) = _synthetic_mnist(
                 self.synthetic_train_size, self.synthetic_test_size)

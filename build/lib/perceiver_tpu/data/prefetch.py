@@ -1,0 +1,91 @@
+"""Background-thread batch prefetching.
+
+The reference keeps its accelerator fed with torch ``DataLoader``
+worker processes (``data/imdb.py:112-126`` sets ``num_workers=3``,
+``data/mnist.py:15``). The JAX equivalent needs no worker *processes* —
+batch assembly is NumPy slicing over preloaded arrays (C under the
+hood) and the jitted step dispatches asynchronously — but the host
+loop must not assemble batch N+1 *after* blocking on step N. A single
+daemon thread with a small bounded queue decouples the two: the device
+runs the current step while the host builds the next batches.
+
+Exceptions raised inside the producer surface on the consumer side at
+the point of ``next()``, matching in-line iteration semantics.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator
+
+import numpy as np
+
+_SENTINEL = object()
+
+
+class PrefetchIterator:
+    """Wrap a batch iterable so iteration overlaps with consumption.
+
+    ``depth`` bounds host memory: at most ``depth`` assembled batches
+    exist beyond the one being consumed. Proxies ``len`` and
+    ``set_epoch`` so it can stand in for a ``BatchIterator``
+    (``perceiver_tpu.data.core``) anywhere, including epoch-seeded
+    shuffling.
+    """
+
+    def __init__(self, inner, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.inner = inner
+        self.depth = depth
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.inner, "set_epoch"):
+            self.inner.set_epoch(epoch)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            """False once the consumer has gone away."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self.inner:
+                    if not put(batch):
+                        return  # consumer exited early: stop, don't
+                        # run the rest of the epoch dry
+            except BaseException as e:  # re-raised on the consumer side
+                put((_SENTINEL, e))
+                return
+            put((_SENTINEL, None))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is _SENTINEL:
+                    err = item[1]
+                    if err is not None:
+                        raise err
+                    return
+                yield item
+        finally:
+            # Early consumer exit (break / preemption): signal the
+            # producer to halt after at most its in-flight batch.
+            stop.set()
+            t.join(timeout=5.0)

@@ -1,0 +1,10 @@
+"""Model library: Perceiver encoder/decoder/IO/MLM and text masking."""
+
+from perceiver_tpu.models.perceiver import (  # noqa: F401
+    PerceiverEncoder,
+    PerceiverDecoder,
+    PerceiverIO,
+    PerceiverMLM,
+)
+from perceiver_tpu.models.masking import TextMasking  # noqa: F401
+from perceiver_tpu.models.uresnet import UResNet  # noqa: F401

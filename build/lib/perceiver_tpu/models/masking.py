@@ -1,0 +1,85 @@
+"""BERT-style text masking with counted JAX PRNG.
+
+Parity target: reference ``perceiver/model.py:240-293`` (with the
+constructor actually usable — the reference's Lightning wrapper passes
+only ``vocab_size`` and crashes; SURVEY.md §2.6.2).
+
+Semantics reproduced exactly:
+
+- UNK and padding positions are protected (``model.py:269-270``).
+- 15% (``mask_p``) of the remaining positions are selected.
+- The reference draws a 0.9 coin for "corrupt" and then a 1/9 coin
+  *within* the corrupted set for "random token" (``model.py:280-281``),
+  giving net probabilities 80% → ``[MASK]``, 10% → random non-special
+  token id (ids assumed to start at ``num_special_tokens``,
+  ``model.py:284-289``), 10% unchanged. We reproduce the same
+  conditional-draw structure with independent PRNG streams.
+- Labels are the original ids with non-selected positions set to −100
+  (``model.py:292``).
+
+Unlike the reference, the input array is never mutated (JAX arrays are
+immutable anyway — the reference corrupts its caller's buffer in place,
+SURVEY.md §2.6.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+@dataclasses.dataclass(frozen=True)
+class TextMasking:
+    vocab_size: int
+    unk_token_id: int
+    mask_token_id: int
+    num_special_tokens: int
+    mask_p: float = 0.15
+
+    @staticmethod
+    def create(tokenizer, **kwargs) -> "TextMasking":
+        """Build from a tokenizer (reference ``model.py:257-263``).
+
+        Works with both the framework's WordPiece tokenizer and any
+        object exposing ``get_vocab_size()`` / ``token_to_id()``.
+        """
+        from perceiver_tpu.tokenizer.vocab import UNK_TOKEN, MASK_TOKEN, SPECIAL_TOKENS
+        return TextMasking(
+            vocab_size=tokenizer.get_vocab_size(),
+            unk_token_id=tokenizer.token_to_id(UNK_TOKEN),
+            mask_token_id=tokenizer.token_to_id(MASK_TOKEN),
+            num_special_tokens=len(SPECIAL_TOKENS),
+            **kwargs)
+
+    def apply(self, rng, x, pad_mask=None):
+        """Corrupt ``x`` (B, L) int32; return ``(x_masked, labels)``."""
+        if pad_mask is None:
+            pad_mask = jnp.zeros_like(x, dtype=bool)
+        r_sel, r_corrupt, r_rand, r_ids = jax.random.split(rng, 4)
+
+        is_special = (x == self.unk_token_id) | pad_mask
+        is_input = ~is_special
+
+        u_sel = jax.random.uniform(r_sel, x.shape)
+        is_selected = (u_sel < self.mask_p) & is_input
+
+        # 0.9 corrupt-coin, then 1/9 random-coin within the corrupted set
+        # (net 80/10/10 — see module docstring).
+        u1 = jax.random.uniform(r_corrupt, x.shape)
+        u2 = jax.random.uniform(r_rand, x.shape)
+        is_corrupted = is_selected & (u1 < 0.9)
+        is_random = is_corrupted & (u2 < (1.0 / 9.0))
+
+        random_ids = jax.random.randint(
+            r_ids, x.shape, self.num_special_tokens, self.vocab_size,
+            dtype=x.dtype)
+
+        x_masked = jnp.where(is_corrupted, self.mask_token_id, x)
+        x_masked = jnp.where(is_random, random_ids, x_masked)
+
+        labels = jnp.where(is_selected, x, IGNORE_INDEX)
+        return x_masked, labels

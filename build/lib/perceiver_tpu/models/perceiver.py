@@ -1,0 +1,417 @@
+"""Perceiver IO models as pure init/apply dataclasses.
+
+Parity targets (reference ``perceiver/model.py``):
+
+- ``PerceiverEncoder`` (``model.py:119-189``): input adapter → learned
+  latent array (trunc-N(0,0.02) clamped ±2) broadcast over batch →
+  ``layer_1`` (unshared) then ``layer_n`` applied ``num_layers - 1``
+  times with **shared weights**. Each perceiver layer is a
+  cross-attention layer (latent ← input, with key-padding mask) followed
+  by a block of self-attention layers (no mask). Returns
+  ``(x_latent, pad_mask)`` — the tuple contract the decoder consumes.
+- ``PerceiverDecoder`` (``model.py:192-237``): learned output query
+  array of shape ``output_adapter.output_shape``, one cross-attention
+  layer (query ← latent, no mask — matching ``model.py:236``), then the
+  output adapter. Supports query chunking for huge output arrays (the
+  262k-query segmentation config) — exact, since output queries only
+  interact with the latent kv, never with each other.
+- ``PerceiverIO`` (``model.py:321-325``): encoder ∘ decoder.
+- ``PerceiverMLM`` (``model.py:296-318``): masking → encoder → decoder →
+  logits sliced to the input length. The reference version crashes
+  (encoder tuple fed to the decoder as a single arg, SURVEY.md §2.6.1);
+  here the plumbing is explicit and correct.
+
+TPU-first design notes:
+
+- The weight-shared ``layer_n`` recurrence and the per-block
+  self-attention stack both run under ``lax.scan`` — each layer body is
+  traced and compiled once regardless of depth, and the stacked
+  parameter pytrees give XLA one big fused HBM layout per block.
+- All residual/attention dropout uses explicitly threaded PRNG keys
+  (scan carries a per-iteration key), so training steps stay pure and
+  reproducible under ``jit`` and ``shard_map``.
+- Latent and output-query broadcasts are ``jnp.broadcast_to`` views —
+  no materialized per-batch copies in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.models.masking import TextMasking
+from perceiver_tpu.ops.attention import (
+    cross_attention_init,
+    cross_attention_apply,
+    self_attention_init,
+    self_attention_apply,
+)
+from perceiver_tpu.ops.dropout import dropout
+from perceiver_tpu.ops.initializers import trunc_normal_clamped
+from perceiver_tpu.ops.mlp import mlp_init, mlp_apply
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+def _rng_or_dummy(rng, deterministic: bool = True):
+    """Dummy key for deterministic paths (scan still needs a key array).
+
+    Raises when randomness is actually required but no rng was given —
+    a silent constant key would reuse the same dropout/masking pattern
+    every step and quietly degrade training.
+    """
+    if rng is None and not deterministic:
+        raise ValueError(
+            "deterministic=False requires an explicit `rng` key")
+    return rng if rng is not None else jax.random.key(0)
+
+
+# --- layer composers (reference model.py:29-44) ------------------------------
+
+
+def cross_attention_layer_init(key, num_q_channels, num_kv_channels,
+                               num_heads, widening_factor=1):
+    ka, km = jax.random.split(key)
+    return {
+        "attn": cross_attention_init(ka, num_q_channels, num_kv_channels,
+                                     num_heads),
+        "mlp": mlp_init(km, num_q_channels, widening_factor),
+    }
+
+
+def cross_attention_layer_apply(params, x_q, x_kv, *, num_heads,
+                                key_padding_mask=None, attn_mask=None,
+                                dropout_rate=0.0, rng=None,
+                                deterministic=True,
+                                policy: Policy = DEFAULT_POLICY,
+                                impl=None, kv_chunk_size=1024, spmd=None):
+    """Residual(CrossAttention) then Residual(mlp) (model.py:29-33)."""
+    k_attn, k_r1, k_r2 = jax.random.split(_rng_or_dummy(rng, deterministic), 3)
+    y = cross_attention_apply(
+        params["attn"], x_q, x_kv, num_heads=num_heads,
+        key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+        dropout_rate=dropout_rate, rng=k_attn, deterministic=deterministic,
+        policy=policy, impl=impl, kv_chunk_size=kv_chunk_size, spmd=spmd)
+    x = x_q + dropout(y, dropout_rate, rng=k_r1, deterministic=deterministic)
+    y = mlp_apply(params["mlp"], x, policy=policy)
+    return x + dropout(y, dropout_rate, rng=k_r2, deterministic=deterministic)
+
+
+def self_attention_layer_init(key, num_channels, num_heads,
+                              widening_factor=1):
+    ka, km = jax.random.split(key)
+    return {
+        "attn": self_attention_init(ka, num_channels, num_heads),
+        "mlp": mlp_init(km, num_channels, widening_factor),
+    }
+
+
+def self_attention_layer_apply(params, x, *, num_heads,
+                               key_padding_mask=None, attn_mask=None,
+                               dropout_rate=0.0, rng=None, deterministic=True,
+                               policy: Policy = DEFAULT_POLICY):
+    k_attn, k_r1, k_r2 = jax.random.split(_rng_or_dummy(rng, deterministic), 3)
+    y = self_attention_apply(
+        params["attn"], x, num_heads=num_heads,
+        key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+        dropout_rate=dropout_rate, rng=k_attn, deterministic=deterministic,
+        policy=policy)
+    x = x + dropout(y, dropout_rate, rng=k_r1, deterministic=deterministic)
+    y = mlp_apply(params["mlp"], x, policy=policy)
+    return x + dropout(y, dropout_rate, rng=k_r2, deterministic=deterministic)
+
+
+def self_attention_block_init(key, num_layers, num_channels, num_heads,
+                              widening_factor=1):
+    """Stacked parameters for ``num_layers`` self-attention layers.
+
+    Leaves carry a leading ``num_layers`` axis so the block applies
+    under a single ``lax.scan`` (one compiled layer body).
+    """
+    keys = jax.random.split(key, num_layers)
+    return jax.vmap(
+        lambda k: self_attention_layer_init(k, num_channels, num_heads,
+                                            widening_factor))(keys)
+
+
+def self_attention_block_apply(stacked, x, *, num_heads, dropout_rate=0.0,
+                               rng=None, deterministic=True,
+                               policy: Policy = DEFAULT_POLICY):
+    num_layers = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    keys = jax.random.split(_rng_or_dummy(rng, deterministic), num_layers)
+
+    def body(carry, layer_in):
+        layer_params, k = layer_in
+        out = self_attention_layer_apply(
+            layer_params, carry, num_heads=num_heads,
+            dropout_rate=dropout_rate, rng=k, deterministic=deterministic,
+            policy=policy)
+        return out, None
+
+    x, _ = jax.lax.scan(body, x, (stacked, keys))
+    return x
+
+
+# --- encoder -----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PerceiverEncoder:
+    """Generic Perceiver IO encoder (reference model.py:119-189)."""
+
+    input_adapter: object
+    latent_shape: Tuple[int, int]  # (N latents, C latent channels)
+    num_layers: int
+    num_cross_attention_heads: int = 4
+    num_self_attention_heads: int = 4
+    num_self_attention_layers_per_block: int = 2
+    dropout: float = 0.0
+    widening_factor: int = 1
+    # Cross-attention kernel for the latent ← input step, the long-kv
+    # hot op: None/"einsum", "chunked" (lax.scan online softmax), or
+    # "flash" (fused Pallas TPU kernel). Self-attention over the small
+    # latent array always uses the einsum path.
+    attention_impl: Optional[str] = None
+    kv_chunk_size: int = 1024
+    # For the shard_map sequence-parallel attention impls ("seqpar",
+    # "ring", "ulysses"): (mesh, seq_axis, batch_axis) describing how
+    # the input token axis is laid out across devices. None for the
+    # single-device / pure-GSPMD paths.
+    spmd: Optional[tuple] = None
+    # Rematerialize each perceiver layer (cross-attn + self-attn block)
+    # on the backward pass: activations inside a layer are recomputed
+    # instead of stored, trading FLOPs for HBM — the lever that fits
+    # the seq-2048 / 12-block configs (BASELINE.md configs[4]).
+    remat: bool = False
+
+    def _layer_init(self, key):
+        kc, ks = jax.random.split(key)
+        return {
+            "cross": cross_attention_layer_init(
+                kc, self.latent_shape[1],
+                self.input_adapter.num_input_channels,
+                self.num_cross_attention_heads, self.widening_factor),
+            "selfs": self_attention_block_init(
+                ks, self.num_self_attention_layers_per_block,
+                self.latent_shape[1], self.num_self_attention_heads,
+                self.widening_factor),
+        }
+
+    def init(self, key):
+        k_adapter, k_latent, k1, kn = jax.random.split(key, 4)
+        params = {
+            "input_adapter": self.input_adapter.init(k_adapter),
+            "latent": trunc_normal_clamped(k_latent, self.latent_shape),
+            "layer_1": self._layer_init(k1),
+        }
+        if self.num_layers > 1:
+            params["layer_n"] = self._layer_init(kn)
+        return params
+
+    def _layer_apply(self, params, latent, x, pad_mask, attn_mask, rng,
+                     deterministic, policy):
+        k_cross, k_selfs = jax.random.split(_rng_or_dummy(rng))
+        latent = cross_attention_layer_apply(
+            params["cross"], latent, x,
+            num_heads=self.num_cross_attention_heads,
+            key_padding_mask=pad_mask, attn_mask=attn_mask,
+            dropout_rate=self.dropout, rng=k_cross,
+            deterministic=deterministic, policy=policy,
+            impl=self.attention_impl, kv_chunk_size=self.kv_chunk_size,
+            spmd=self.spmd)
+        return self_attention_block_apply(
+            params["selfs"], latent,
+            num_heads=self.num_self_attention_heads,
+            dropout_rate=self.dropout, rng=k_selfs,
+            deterministic=deterministic, policy=policy)
+
+    def apply(self, params, x, pad_mask=None, attn_mask=None, *, rng=None,
+              deterministic: bool = True, policy: Policy = DEFAULT_POLICY):
+        """Returns ``(x_latent, pad_mask)`` (reference model.py:189)."""
+        b = x.shape[0]
+        x = self.input_adapter.apply(params["input_adapter"], x,
+                                     policy=policy)
+        latent = jnp.broadcast_to(
+            policy.cast_param(params["latent"])[None],
+            (b, *self.latent_shape))
+
+        k1, kn = jax.random.split(_rng_or_dummy(rng, deterministic))
+
+        def one_layer(layer_params, latent, k):
+            return self._layer_apply(layer_params, latent, x, pad_mask,
+                                     attn_mask, k, deterministic, policy)
+
+        if self.remat:
+            one_layer = jax.checkpoint(one_layer)
+
+        latent = one_layer(params["layer_1"], latent, k1)
+        if self.num_layers > 1:
+            # Weight-shared recurrence (model.py:186-187): one compiled
+            # body, scanned num_layers-1 times over per-iteration keys.
+            keys = jax.random.split(kn, self.num_layers - 1)
+            layer_n = params["layer_n"]
+
+            def body(carry, k):
+                return one_layer(layer_n, carry, k), None
+
+            latent, _ = jax.lax.scan(body, latent, keys)
+        return latent, pad_mask
+
+
+# --- decoder -----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PerceiverDecoder:
+    """Generic Perceiver IO decoder (reference model.py:192-237)."""
+
+    output_adapter: object
+    latent_shape: Tuple[int, int]
+    num_cross_attention_heads: int = 4
+    dropout: float = 0.0
+    widening_factor: int = 1
+    # Chunk the K output queries through cross-attention + mlp in slices
+    # of this size (None = no chunking). Exact: queries never attend to
+    # each other. Needed for the 262k-query segmentation config where
+    # the full (B, K, N) attention-weight tensor would blow HBM.
+    query_chunk_size: Optional[int] = None
+    # Attention kernel for the output-query ← latent cross-attention
+    # (see PerceiverEncoder.attention_impl). "flash" blocks over the
+    # query axis in-kernel, an alternative to query_chunk_size for the
+    # 262k-query config.
+    attention_impl: Optional[str] = None
+    kv_chunk_size: int = 1024
+
+    def init(self, key):
+        k_out, k_query, k_cross = jax.random.split(key, 3)
+        return {
+            "output_adapter": self.output_adapter.init(k_out),
+            "query": trunc_normal_clamped(k_query,
+                                          self.output_adapter.output_shape),
+            "cross": cross_attention_layer_init(
+                k_cross, self.output_adapter.output_shape[-1],
+                self.latent_shape[1], self.num_cross_attention_heads,
+                self.widening_factor),
+        }
+
+    def apply(self, params, x, pad_mask=None, *, rng=None,
+              deterministic: bool = True, policy: Policy = DEFAULT_POLICY,
+              return_hidden: bool = False):
+        """``pad_mask`` is accepted for the encoder-tuple contract but —
+        matching the reference (model.py:229,236) — not applied in the
+        decoder cross-attention (the latent kv has no padding).
+
+        ``return_hidden=True`` skips the output adapter and returns the
+        pre-projection ``(B, K, C)`` query states — the hook for fused
+        projection+loss kernels (``perceiver_tpu.ops.fused_ce``)."""
+        del pad_mask
+        b, *d = x.shape
+        if tuple(d) != tuple(self.latent_shape):
+            raise ValueError(
+                f"Latent shape {tuple(d)} different from required shape "
+                f"{tuple(self.latent_shape)}")
+
+        query = jnp.broadcast_to(
+            policy.cast_param(params["query"])[None],
+            (b, *self.output_adapter.output_shape))
+
+        def run(q, k):
+            return cross_attention_layer_apply(
+                params["cross"], q, x,
+                num_heads=self.num_cross_attention_heads,
+                dropout_rate=self.dropout, rng=k,
+                deterministic=deterministic, policy=policy,
+                impl=self.attention_impl, kv_chunk_size=self.kv_chunk_size)
+
+        num_q = query.shape[1]
+        cs = self.query_chunk_size
+        if cs is not None and num_q > cs:
+            if num_q % cs != 0:
+                raise ValueError(
+                    f"query_chunk_size {cs} must divide num queries {num_q}")
+            n_chunks = num_q // cs
+            chunks = query.reshape(b, n_chunks, cs, -1).swapaxes(0, 1)
+            keys = jax.random.split(_rng_or_dummy(rng, deterministic), n_chunks)
+            out = jax.lax.map(lambda qk: run(qk[0], qk[1]), (chunks, keys))
+            out = out.swapaxes(0, 1).reshape(b, num_q, -1)
+        else:
+            out = run(query, _rng_or_dummy(rng, deterministic))
+        if return_hidden:
+            return out
+        return self.output_adapter.apply(params["output_adapter"], out,
+                                         policy=policy)
+
+
+# --- composed models ---------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PerceiverIO:
+    """Encoder ∘ decoder (reference model.py:321-325)."""
+
+    encoder: PerceiverEncoder
+    decoder: PerceiverDecoder
+
+    def init(self, key):
+        ke, kd = jax.random.split(key)
+        return {"encoder": self.encoder.init(ke),
+                "decoder": self.decoder.init(kd)}
+
+    def apply(self, params, x, pad_mask=None, *, rng=None,
+              deterministic: bool = True, policy: Policy = DEFAULT_POLICY):
+        ke, kd = jax.random.split(_rng_or_dummy(rng, deterministic))
+        latent, pad_mask = self.encoder.apply(
+            params["encoder"], x, pad_mask, rng=ke,
+            deterministic=deterministic, policy=policy)
+        return self.decoder.apply(
+            params["decoder"], latent, pad_mask, rng=kd,
+            deterministic=deterministic, policy=policy)
+
+
+@dataclasses.dataclass(frozen=True)
+class PerceiverMLM:
+    """Masked-language model (reference model.py:296-318, plumbing fixed)."""
+
+    encoder: PerceiverEncoder
+    decoder: PerceiverDecoder
+    masking: TextMasking
+
+    def init(self, key):
+        ke, kd = jax.random.split(key)
+        return {"encoder": self.encoder.init(ke),
+                "decoder": self.decoder.init(kd)}
+
+    def apply(self, params, x_input, pad_mask=None, *, masking: bool = True,
+              rng=None, deterministic: bool = True,
+              policy: Policy = DEFAULT_POLICY, return_hidden: bool = False):
+        """Returns ``(logits, labels)``; ``labels`` is None when
+        ``masking=False`` (inference path, reference utils.py:30).
+
+        ``return_hidden=True`` returns pre-vocab-projection decoder
+        states ``(B, l, C)`` instead of logits (fused-loss hook; the
+        vocab projection then happens inside the loss, see
+        ``perceiver_tpu.ops.fused_ce``)."""
+        l = x_input.shape[1]
+        if masking and rng is None:
+            # a silent constant key would mask the same positions in
+            # every batch — val_loss would be computed on one fixed,
+            # position-correlated 15% subset
+            raise ValueError("masking=True requires an explicit `rng` key")
+        k_mask, k_enc, k_dec = jax.random.split(
+            _rng_or_dummy(rng, deterministic), 3)
+
+        if masking:
+            x_masked, labels = self.masking.apply(k_mask, x_input, pad_mask)
+        else:
+            x_masked, labels = x_input, None
+
+        latent, _ = self.encoder.apply(
+            params["encoder"], x_masked, pad_mask, rng=k_enc,
+            deterministic=deterministic, policy=policy)
+        out = self.decoder.apply(
+            params["decoder"], latent, rng=k_dec,
+            deterministic=deterministic, policy=policy,
+            return_hidden=return_hidden)[:, :l, :]
+        return out, labels

@@ -1,0 +1,190 @@
+"""U-ResNet semantic-segmentation network, TPU-native (NHWC, pure
+init/apply, explicit BatchNorm state).
+
+Parity target: reference ``uresnet.py`` (MicroBooNE track/shower
+segmentation U-Net with ResNet bottleneck blocks, ``uresnet.py:6-18``):
+
+- stem of three 3×3 convs (≈ one 7×7, ``uresnet.py:143-155``),
+- four ``DoubleResNet`` encoding stages, each stride 2 and doubling
+  channels (``uresnet.py:157-160``),
+- four transpose-conv decoding stages with skip concatenations
+  (``uresnet.py:162-165``, forward ``uresnet.py:236-263``),
+- final three-conv stem + 1×1 conv to ``num_classes``
+  (``uresnet.py:167-183``),
+- Kaiming-style N(0, sqrt(2/n)) conv init, BN scale 1 / bias 0
+  (``uresnet.py:186-193``).
+
+The reference's ``Bottleneck`` has no channel expansion and projects
+the shortcut only when stride > 1 (``uresnet.py:75-79``) — which also
+happens to be the only case where its channel counts change. Here the
+shortcut is projected whenever stride > 1 *or* channels change, the
+same behavior on every reachable configuration but total instead of
+partial.
+
+Apply signature: ``model.apply((params, state), x, train=...)`` returns
+``(logits, new_state)`` where ``state`` carries the BatchNorm running
+statistics — torch mutates these in place; a pure step threads them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.conv import (
+    batch_norm_apply,
+    batch_norm_init,
+    conv_apply,
+    conv_init,
+    conv_transpose_apply,
+    kaiming_normal_conv,
+)
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+def _conv_bn_init(key, in_ch, out_ch, kernel=3, bias=True):
+    params = conv_init(key, in_ch, out_ch, kernel, bias=bias)
+    bn_params, bn_state = batch_norm_init(out_ch)
+    return {"conv": params, "bn": bn_params}, {"bn": bn_state}
+
+
+def _conv_bn_apply(params, state, x, *, stride=1, train, relu=True,
+                   policy=DEFAULT_POLICY):
+    y = conv_apply(params["conv"], x, stride=stride, policy=policy)
+    y, bn_state = batch_norm_apply(params["bn"], state["bn"], y,
+                                   train=train, policy=policy)
+    if relu:
+        y = jax.nn.relu(y)
+    return y, {"bn": bn_state}
+
+
+def _bottleneck_init(key, in_ch, planes, stride):
+    k1, k2, k3, ks = jax.random.split(key, 4)
+    params, state = {}, {}
+    params["c1"], state["c1"] = _conv_bn_init(k1, in_ch, planes, 1,
+                                              bias=False)
+    params["c2"], state["c2"] = _conv_bn_init(k2, planes, planes, 3,
+                                              bias=False)
+    params["c3"], state["c3"] = _conv_bn_init(k3, planes, planes, 1,
+                                              bias=False)
+    if stride > 1 or in_ch != planes:
+        params["shortcut"] = conv_init(ks, in_ch, planes, 1, bias=False)
+    return params, state
+
+
+def _bottleneck_apply(params, state, x, *, stride, train,
+                      policy=DEFAULT_POLICY):
+    if "shortcut" in params:
+        bypass = conv_apply(params["shortcut"], x, stride=stride,
+                            policy=policy)
+    else:
+        bypass = x
+    r, s1 = _conv_bn_apply(params["c1"], state["c1"], x, train=train,
+                           policy=policy)
+    r, s2 = _conv_bn_apply(params["c2"], state["c2"], r, stride=stride,
+                           train=train, policy=policy)
+    r, s3 = _conv_bn_apply(params["c3"], state["c3"], r, train=train,
+                           relu=False, policy=policy)
+    return jax.nn.relu(bypass + r), {"c1": s1, "c2": s2, "c3": s3}
+
+
+def _double_resnet_init(key, in_ch, planes, stride):
+    ka, kb = jax.random.split(key)
+    pa, sa = _bottleneck_init(ka, in_ch, planes, stride)
+    pb, sb = _bottleneck_init(kb, planes, planes, 1)
+    return {"res1": pa, "res2": pb}, {"res1": sa, "res2": sb}
+
+
+def _double_resnet_apply(params, state, x, *, stride, train,
+                         policy=DEFAULT_POLICY):
+    y, s1 = _bottleneck_apply(params["res1"], state["res1"], x,
+                              stride=stride, train=train, policy=policy)
+    y, s2 = _bottleneck_apply(params["res2"], state["res2"], y,
+                              stride=1, train=train, policy=policy)
+    return y, {"res1": s1, "res2": s2}
+
+
+def _deconv_layer_init(key, in_ch, out_ch):
+    kr, kd = jax.random.split(key)
+    pr, sr = _bottleneck_init(kr, in_ch, in_ch, 1)
+    w = kaiming_normal_conv(kd, (3, 3, in_ch, out_ch))
+    return {"res": pr, "deconv": {"w": w}}, {"res": sr}
+
+
+def _deconv_layer_apply(params, state, x, *, train, policy=DEFAULT_POLICY):
+    y, sr = _bottleneck_apply(params["res"], state["res"], x, stride=1,
+                              train=train, policy=policy)
+    y = conv_transpose_apply(params["deconv"], y, stride=2, policy=policy)
+    return y, {"res": sr}
+
+
+@dataclasses.dataclass(frozen=True)
+class UResNet:
+    num_classes: int = 3
+    input_channels: int = 3
+    inplanes: int = 16
+    head_kernels: int = 16  # reference ``nkernels`` (uresnet.py:168)
+
+    def init(self, key):
+        """Returns ``(params, state)``."""
+        p = self.inplanes
+        keys = iter(jax.random.split(key, 16))
+        params, state = {}, {}
+        for i, (ci, co) in enumerate(
+                [(self.input_channels, p), (p, p), (p, p)], start=1):
+            params[f"stem{i}"], state[f"stem{i}"] = _conv_bn_init(
+                next(keys), ci, co)
+        for i in range(1, 5):
+            ci = p * 2 ** (i - 1)
+            params[f"enc{i}"], state[f"enc{i}"] = _double_resnet_init(
+                next(keys), ci, ci * 2, stride=2)
+        # dec4 consumes enc4's 16p; dec3..dec1 consume [deconv ‖ skip]
+        for i, (ci, co) in zip(range(4, 0, -1),
+                               [(p * 16, p * 8), (p * 16, p * 4),
+                                (p * 8, p * 2), (p * 4, p * 1)]):
+            params[f"dec{i}"], state[f"dec{i}"] = _deconv_layer_init(
+                next(keys), ci, co)
+        nk = self.head_kernels
+        for i, (ci, co) in enumerate(
+                [(p, nk), (nk, nk * 2), (nk * 2, nk)], start=1):
+            params[f"head{i}"], state[f"head{i}"] = _conv_bn_init(
+                next(keys), ci, co)
+        params["classify"] = conv_init(next(keys), nk, self.num_classes,
+                                       kernel=1)
+        return params, state
+
+    def apply(self, variables, x, *, train: bool = False,
+              policy: Policy = DEFAULT_POLICY
+              ) -> Tuple[jnp.ndarray, dict]:
+        """``x``: (B, H, W, C) with H, W divisible by 16. Returns
+        per-pixel logits (B, H, W, num_classes) and the updated
+        BatchNorm state (unchanged when ``train=False``)."""
+        params, state = variables
+        new_state = {}
+
+        def cb(name, y, **kw):
+            out, new_state[name] = _conv_bn_apply(
+                params[name], state[name], y, train=train, policy=policy,
+                **kw)
+            return out
+
+        y = cb("stem3", cb("stem2", cb("stem1", x)))
+        skips = [y]
+        for i in range(1, 5):
+            y, new_state[f"enc{i}"] = _double_resnet_apply(
+                params[f"enc{i}"], state[f"enc{i}"], y, stride=2,
+                train=train, policy=policy)
+            skips.append(y)
+        for i in range(4, 0, -1):
+            y, new_state[f"dec{i}"] = _deconv_layer_apply(
+                params[f"dec{i}"], state[f"dec{i}"], y, train=train,
+                policy=policy)
+            if i > 1:  # reference concatenates x3, x2, x1 but not x0
+                y = jnp.concatenate(
+                    [y, policy.cast_compute(skips[i - 1])], axis=-1)
+        y = cb("head3", cb("head2", cb("head1", y)))
+        logits = conv_apply(params["classify"], y, policy=policy)
+        return logits, new_state

@@ -1,0 +1,18 @@
+"""Core tensor ops: pure init/apply functions over parameter pytrees."""
+
+from perceiver_tpu.ops.policy import Policy  # noqa: F401
+from perceiver_tpu.ops.linear import linear_init, linear_apply  # noqa: F401
+from perceiver_tpu.ops.norm import layer_norm_init, layer_norm_apply  # noqa: F401
+from perceiver_tpu.ops.mlp import mlp_init, mlp_apply  # noqa: F401
+from perceiver_tpu.ops.attention import (  # noqa: F401
+    mha_init,
+    mha_apply,
+    cross_attention_init,
+    cross_attention_apply,
+    self_attention_init,
+    self_attention_apply,
+)
+# chunked_attention / flash_attention are NOT re-exported here:
+# the former would shadow its own submodule on the package namespace,
+# and the latter would eagerly import jax.experimental.pallas for
+# einsum-only users. Import them from their submodules.

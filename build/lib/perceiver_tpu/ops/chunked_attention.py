@@ -1,0 +1,141 @@
+"""Memory-efficient blockwise attention (pure JAX, differentiable).
+
+Computes exact softmax attention while streaming the key/value sequence
+in fixed-size chunks under ``lax.scan``, carrying online-softmax
+statistics ``(m, l, acc)`` — the Rabe & Staats / FlashAttention
+recurrence. Peak memory is O(Lq · chunk) instead of O(Lq · Lk), which
+is what makes the reference's large-input configs feasible on a TPU
+chip: the 512×512 LArTPC segmentation model (``run.py:79``) cross-
+attends 32 latent queries against M = 262,144 input tokens, where a
+materialized (B, H, Lq, Lk) fp32 weight tensor would be ~128 MB per
+(batch, head) pair.
+
+Differentiable out of the box (the scan transposes cleanly), so it
+also serves as the backward path for the Pallas flash kernel
+(``perceiver_tpu.ops.pallas_attention``), keeping the backward pass
+memory-bounded too.
+
+Masking is expressed as an additive fp32 bias over keys (``(B, Lk)``,
+0 where attended, ``NEG_INF`` where padded) — the same semantics the
+einsum path applies via ``key_padding_mask`` (reference
+``data/imdb.py:64``: True at padding).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def pad_mask_to_bias(key_padding_mask, dtype=jnp.float32):
+    """(B, Lk) bool, True at padding → additive (B, Lk) bias."""
+    return jnp.where(key_padding_mask, NEG_INF, 0.0).astype(dtype)
+
+
+def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc):
+    """One online-softmax block fold — THE shared recurrence.
+
+    Folds a key/value block into running statistics. Used by the kv
+    scan here and by the ring/sequence-parallel paths
+    (``perceiver_tpu.parallel.ring_attention``), so all blockwise
+    implementations share one copy of the numerics (including the
+    uniform-average convention for fully-masked rows — all-NEG_INF
+    logits give p = 1, matching plain softmax's uniform weights).
+
+    q: (B,H,Lq,D); k_blk, v_blk: (B,H,Lk,D); bias_blk: (B,Lk) or None;
+    m, l: (B,H,Lq,1); acc: (B,H,Lq,D) — fp32 accumulators.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    if bias_blk is not None:
+        s = s + bias_blk[:, None, None, :].astype(jnp.float32)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def finalize_softmax(l, acc, dtype):
+    """acc / l with a 0/0 guard (see fully-masked-row note above)."""
+    return (acc / jnp.maximum(l, 1e-30)).astype(dtype)
+
+
+def chunked_attention(q, k, v, *, bias: Optional[jax.Array] = None,
+                      scale: Optional[float] = None,
+                      chunk_size: int = 1024,
+                      q_chunk_size: Optional[int] = None):
+    """Exact attention with kv streamed in chunks.
+
+    q: (B, H, Lq, D); k, v: (B, H, Lk, D).
+    bias: optional (B, Lk) additive key bias (fp32, NEG_INF at pad).
+    q_chunk_size: additionally block the query axis (lax.map over query
+    slices) — needed when Lq is huge (the 262k-query decoder), where
+    even one (B, H, Lq, chunk) logit block would blow HBM.
+    Returns (B, H, Lq, D) in q's dtype.
+
+    The kv scan body is rematerialized (``jax.checkpoint``), so the
+    backward pass recomputes each chunk's softmax block instead of
+    saving all of them — keeping grad memory O(Lq · chunk) as well.
+
+    Fully-masked rows (every key padded) return the uniform average of
+    v — the same garbage-by-construction the plain-softmax path
+    produces (all logits collapse to NEG_INF, so softmax is uniform);
+    the ``maximum(l, ...)`` guard only protects against exact 0/0.
+    """
+    b, h, lq, d = q.shape
+    if q_chunk_size is not None and lq > q_chunk_size:
+        qc = q_chunk_size
+        q_pad = (-lq) % qc
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
+        nq = (lq + q_pad) // qc
+        qs = qp.reshape(b, h, nq, qc, d).transpose(2, 0, 1, 3, 4)
+        out = jax.lax.map(
+            lambda qi: chunked_attention(qi, k, v, bias=bias, scale=scale,
+                                         chunk_size=chunk_size),
+            qs)
+        out = out.transpose(1, 2, 0, 3, 4).reshape(b, h, nq * qc, d)
+        return out[:, :, :lq]
+    lk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    chunk = min(chunk_size, lk)
+    pad = (-lk) % chunk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        bias = jnp.pad(bias if bias is not None
+                       else jnp.zeros((b, lk), jnp.float32),
+                       ((0, 0), (0, pad)), constant_values=NEG_INF)
+    n_chunks = (lk + pad) // chunk
+
+    # chunk-major stacking for scan: (n, B, H, chunk, D)
+    kc = k.reshape(b, h, n_chunks, chunk, d).transpose(2, 0, 1, 3, 4)
+    vc = v.reshape(b, h, n_chunks, chunk, d).transpose(2, 0, 1, 3, 4)
+    if bias is not None:
+        bc = bias.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+        xs = (kc, vc, bc)
+    else:
+        xs = (kc, vc)
+
+    m0 = jnp.full((b, h, lq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
+
+    def body(carry, x):
+        if bias is not None:
+            k_i, v_i, b_i = x
+        else:
+            (k_i, v_i), b_i = x, None
+        return fold_block(q, k_i, v_i, b_i, scale, *carry), None
+
+    (m, l, acc), _ = jax.lax.scan(jax.checkpoint(body), (m0, l0, acc0), xs)
+    return finalize_softmax(l, acc, q.dtype)

@@ -1,0 +1,111 @@
+"""2-D convolution, transpose convolution, and BatchNorm as pure
+init/apply functions, NHWC throughout.
+
+NHWC is the TPU-native layout: XLA tiles the channel axis onto the MXU
+lane dimension and folds 3×3 spatial taps into the contraction, so
+convs here lower to MXU matmuls without layout transposes (the torch
+reference is NCHW; translating that layout would cost a transpose per
+op on TPU).
+
+BatchNorm is stateful in the reference (``nn.BatchNorm2d`` running
+stats, ``uresnet.py``); here the running stats live in an explicit
+``state`` pytree that train-mode apply returns updated — the caller
+threads it like any other carry, keeping every step pure under ``jit``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def kaiming_normal_conv(key, shape, dtype=jnp.float32):
+    """N(0, sqrt(2/n)) with n = kh·kw·out_channels — the reference
+    UResNet's explicit init (``uresnet.py:186-193``)."""
+    kh, kw, _, out_ch = shape
+    std = math.sqrt(2.0 / (kh * kw * out_ch))
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def conv_init(key, in_ch: int, out_ch: int, kernel: int = 3,
+              bias: bool = True, dtype=jnp.float32):
+    wk, _ = jax.random.split(key)
+    params = {"w": kaiming_normal_conv(
+        wk, (kernel, kernel, in_ch, out_ch), dtype)}
+    if bias:
+        params["b"] = jnp.zeros((out_ch,), dtype)
+    return params
+
+
+def conv_apply(params, x, stride: int = 1, *,
+               policy: Policy = DEFAULT_POLICY):
+    """3×3 (or k×k) SAME conv; stride 2 halves H,W exactly for even
+    sizes (matching torch k=3/pad=1/stride=2 on the even shapes the
+    segmentation net uses)."""
+    w = policy.cast_param(params["w"])
+    y = jax.lax.conv_general_dilated(
+        policy.cast_compute(x), w,
+        window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=_DIMS)
+    if "b" in params:
+        y = y + policy.cast_param(params["b"])
+    return y
+
+
+def conv_transpose_apply(params, x, stride: int = 2, *,
+                         policy: Policy = DEFAULT_POLICY):
+    """SAME transpose conv: exactly doubles H,W at stride 2 — the
+    shape contract torch expresses via ``output_size=`` at call time
+    (``uresnet.py:120-124``) made static instead."""
+    w = policy.cast_param(params["w"])
+    y = jax.lax.conv_transpose(
+        policy.cast_compute(x), w,
+        strides=(stride, stride), padding="SAME",
+        dimension_numbers=_DIMS)
+    if "b" in params:
+        y = y + policy.cast_param(params["b"])
+    return y
+
+
+def batch_norm_init(dim: int, dtype=jnp.float32):
+    """Returns (params, state): scale/bias are learned; mean/var are
+    running statistics updated by train-mode apply."""
+    params = {"scale": jnp.ones((dim,), dtype),
+              "bias": jnp.zeros((dim,), dtype)}
+    state = {"mean": jnp.zeros((dim,), dtype),
+             "var": jnp.ones((dim,), dtype)}
+    return params, state
+
+
+def batch_norm_apply(params, state, x, *, train: bool,
+                     momentum: float = 0.1, eps: float = 1e-5,
+                     policy: Policy = DEFAULT_POLICY
+                     ) -> Tuple[jnp.ndarray, Optional[dict]]:
+    """Normalize over (N,H,W) per channel. Train mode uses batch stats
+    and returns the updated running-stat state; eval mode uses the
+    running stats and returns ``state`` unchanged. Statistics always in
+    fp32 (bf16 variance accumulation is lossy)."""
+    xf = x.astype(policy.norm_dtype)
+    if train:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        unbiased = var * (n / max(n - 1, 1))
+        new_state = {
+            "mean": (1 - momentum) * state["mean"] + momentum * mean,
+            "var": (1 - momentum) * state["var"] + momentum * unbiased,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = (y * params["scale"].astype(policy.norm_dtype)
+         + params["bias"].astype(policy.norm_dtype))
+    return y.astype(policy.compute_dtype), new_state

@@ -1,0 +1,17 @@
+"""Dropout with explicit PRNG threading (JAX-functional)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dropout(x, rate: float, *, rng=None, deterministic: bool = True):
+    """Inverted dropout. No-op when deterministic or rate == 0."""
+    if deterministic or rate == 0.0:
+        return x
+    if rng is None:
+        raise ValueError("dropout needs an rng when not deterministic")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x)).astype(x.dtype)

@@ -1,0 +1,95 @@
+"""Memory-efficient fused linear + cross-entropy for huge vocabularies.
+
+The reference computes MLM loss as CE over dense ``(B, M, V)`` logits
+(``perceiver/lightning.py:223-226``) — fine at V=10003 on GPU batch 64,
+but on TPU the fp32 log-softmax over ``(B, 512, 10003)`` is the HBM
+hot spot: at batch 512 the logits alone exceed v5e HBM. Two TPU-first
+levers, both exact w.r.t. the dense computation:
+
+1. ``fused_linear_cross_entropy`` — never materializes the full logits.
+   Positions are processed in chunks under ``jax.checkpoint``: each
+   chunk projects to the vocab on the MXU, reduces to per-position NLL
+   in fp32, and discards its logits; the backward pass recomputes them
+   per chunk. Peak memory is one chunk of logits instead of all of them.
+
+2. ``pack_positions`` — MLM loss touches only the ~15% of positions
+   selected by BERT masking (labels of non-selected positions are the
+   ignore value, so their NLL is multiplied by zero and their logit
+   gradient is exactly zero). A cumsum + scatter packs the contributing
+   positions into a fixed-capacity buffer, so the dominant vocab
+   projection runs on ~15% of the rows. Gradients are identical to the
+   dense computation (zero-weight rows contribute zero either way);
+   the only approximation is the static capacity, chosen so overflow
+   has negligible probability (a Chernoff bound at capacity 1.5× the
+   expected count is astronomically small for B·M ≥ 2¹⁵).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.linear import linear_apply
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+def pack_positions(hidden, labels, weight, capacity: int):
+    """Scatter rows with nonzero ``weight`` into a ``capacity``-row buffer.
+
+    hidden: (N, C); labels: (N,) int; weight: (N,) fp32 (0 or positive).
+    Returns (hidden_p, labels_p, weight_p) of leading dim ``capacity``.
+    Rows beyond the number of contributing positions have weight 0.
+    Contributing rows past ``capacity`` (overflow) are dropped — size
+    ``capacity`` generously (see module docstring).
+    """
+    n, c = hidden.shape
+    contributes = weight > 0
+    dest = jnp.cumsum(contributes.astype(jnp.int32)) - 1
+    # non-contributing and overflow rows all land on a dump row that is
+    # sliced off below (duplicate scatter indices are fine there)
+    dest = jnp.where(contributes & (dest < capacity), dest, capacity)
+    hidden_p = jnp.zeros((capacity + 1, c), hidden.dtype).at[dest].set(hidden)
+    labels_p = jnp.zeros((capacity + 1,), labels.dtype).at[dest].set(labels)
+    weight_p = jnp.zeros((capacity + 1,), jnp.float32).at[dest].set(
+        weight.astype(jnp.float32))
+    return hidden_p[:capacity], labels_p[:capacity], weight_p[:capacity]
+
+
+def fused_linear_cross_entropy(linear_params, hidden, labels, weight, *,
+                               chunk_size: int = 8192,
+                               policy: Policy = DEFAULT_POLICY):
+    """Weighted-mean CE of ``linear(hidden)`` vs ``labels``, chunked.
+
+    hidden: (N, C) flattened positions; labels: (N,) int (any value on
+    zero-weight rows); weight: (N,) fp32. Numerically identical to
+    ``cross_entropy(linear_apply(params, hidden), labels)`` with the
+    same fp32 log-softmax, but peak memory is one ``(chunk, V)`` logits
+    block and the backward pass recomputes logits chunk-by-chunk.
+    Returns scalar ``sum(w·nll) / max(sum(w), 1)``.
+    """
+    n, c = hidden.shape
+    if n % chunk_size != 0:
+        pad = chunk_size - n % chunk_size
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad))
+        weight = jnp.pad(weight, (0, pad))
+        n += pad
+    k = n // chunk_size
+    hidden = hidden.reshape(k, chunk_size, c)
+    labels = labels.reshape(k, chunk_size)
+    weight = weight.reshape(k, chunk_size).astype(jnp.float32)
+
+    @jax.checkpoint
+    def chunk_nll(h, y, w):
+        logits = linear_apply(linear_params, h, policy=policy)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, jnp.clip(y, 0)[:, None], axis=1)[:, 0]
+        return (nll * w).sum()
+
+    def body(carry, xs):
+        h, y, w = xs
+        return carry + chunk_nll(h, y, w), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                            (hidden, labels, weight))
+    return total / jnp.maximum(weight.sum(), 1.0)

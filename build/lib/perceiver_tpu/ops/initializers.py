@@ -1,0 +1,51 @@
+"""Parameter initializers matching the reference's effective init scheme.
+
+The reference relies on torch defaults plus a few explicit inits
+(SURVEY.md §2.1 / §7.1):
+
+- ``nn.Linear``: Kaiming-uniform weights == U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+  for both weight and bias (torch's reset_parameters).
+- ``nn.MultiheadAttention`` q/k/v projections: Xavier-uniform, zero bias
+  (torch MultiheadAttention._reset_parameters).
+- Latent / output-query arrays: truncated N(0, 0.02) clamped to ±2
+  (reference ``perceiver/model.py:169-174`` and ``model.py:222-227``).
+- Token embedding: U(-0.1, 0.1) (reference ``perceiver/adapter.py:122``);
+  positional embedding: U(-0.5, 0.5) (``adapter.py:124``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def torch_linear_uniform(key, shape, fan_in: int, dtype=jnp.float32):
+    """U(-1/sqrt(fan_in), 1/sqrt(fan_in)) — torch nn.Linear default."""
+    bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def xavier_uniform(key, shape, dtype=jnp.float32):
+    """Xavier/Glorot uniform for 2-D (in, out) weights."""
+    fan_in, fan_out = shape[0], shape[-1]
+    bound = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def trunc_normal_clamped(key, shape, std: float = 0.02, clamp: float = 2.0,
+                         dtype=jnp.float32):
+    """N(0, std) with hard clamp to ±clamp.
+
+    Mirrors the reference latent init: ``normal_(0.0, 0.02).clamp_(-2, 2)``
+    (``perceiver/model.py:172-174``). Note the reference clamps *after*
+    sampling rather than using a true truncated normal; we reproduce the
+    clamp semantics.
+    """
+    x = std * jax.random.normal(key, shape, dtype)
+    return jnp.clip(x, -clamp, clamp)
+
+
+def uniform(key, shape, bound: float, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)

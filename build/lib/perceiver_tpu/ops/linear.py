@@ -1,0 +1,24 @@
+"""Dense layer as pure init/apply functions."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.initializers import torch_linear_uniform
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+def linear_init(key, in_dim: int, out_dim: int, dtype=jnp.float32):
+    """Parameters for y = x @ w + b, torch nn.Linear-style init."""
+    wk, bk = jax.random.split(key)
+    return {
+        "w": torch_linear_uniform(wk, (in_dim, out_dim), in_dim, dtype),
+        "b": torch_linear_uniform(bk, (out_dim,), in_dim, dtype),
+    }
+
+
+def linear_apply(params, x, policy: Policy = DEFAULT_POLICY):
+    w = policy.cast_param(params["w"])
+    b = policy.cast_param(params["b"])
+    return policy.cast_compute(x) @ w + b

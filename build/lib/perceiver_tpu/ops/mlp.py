@@ -1,0 +1,33 @@
+"""Transformer MLP block.
+
+Reference semantics (``perceiver/model.py:20-26``): LayerNorm →
+Linear(C→H) → GELU → Linear(H→C) where H == C — the reference uses **no
+4× expansion**; hidden width equals channel width. ``widening_factor``
+keeps that default while allowing larger configs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.linear import linear_init, linear_apply
+from perceiver_tpu.ops.norm import layer_norm_init, layer_norm_apply
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+def mlp_init(key, dim: int, widening_factor: int = 1, dtype=jnp.float32):
+    k1, k2 = jax.random.split(key)
+    hidden = dim * widening_factor
+    return {
+        "norm": layer_norm_init(dim, dtype),
+        "fc1": linear_init(k1, dim, hidden, dtype),
+        "fc2": linear_init(k2, hidden, dim, dtype),
+    }
+
+
+def mlp_apply(params, x, policy: Policy = DEFAULT_POLICY):
+    h = layer_norm_apply(params["norm"], x, policy=policy)
+    h = linear_apply(params["fc1"], h, policy=policy)
+    h = jax.nn.gelu(h, approximate=False)
+    return linear_apply(params["fc2"], h, policy=policy)

@@ -1,0 +1,28 @@
+"""LayerNorm as pure init/apply functions.
+
+Statistics are computed in fp32 regardless of the compute dtype —
+bf16 mean/variance accumulation loses precision the MXU gains nothing
+from, and XLA fuses the fp32 reduce into surrounding ops anyway.
+"""
+
+from __future__ import annotations
+
+import jax.lax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+
+def layer_norm_init(dim: int, dtype=jnp.float32):
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def layer_norm_apply(params, x, eps: float = 1e-5,
+                     policy: Policy = DEFAULT_POLICY):
+    xf = x.astype(policy.norm_dtype)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = (y * params["scale"].astype(policy.norm_dtype)
+         + params["bias"].astype(policy.norm_dtype))
+    return y.astype(policy.compute_dtype)

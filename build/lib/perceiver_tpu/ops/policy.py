@@ -1,0 +1,45 @@
+"""Mixed-precision policy.
+
+The reference trains in fp32 (``scripts/trainer.yaml:49`` sets
+``precision: 32``). On TPU the MXU natively consumes bfloat16, so the
+framework default keeps parameters in fp32 and computes in bf16, with
+softmax/normalization statistics accumulated in fp32. fp32-everywhere
+remains available via ``Policy.fp32()`` for parity checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype policy applied at module boundaries.
+
+    param_dtype:   dtype parameters are stored in.
+    compute_dtype: dtype activations/matmuls run in (MXU-friendly).
+    norm_dtype:    dtype for normalization / softmax statistics.
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    norm_dtype: jnp.dtype = jnp.float32
+
+    @staticmethod
+    def fp32() -> "Policy":
+        return Policy(compute_dtype=jnp.float32)
+
+    @staticmethod
+    def bf16() -> "Policy":
+        return Policy(compute_dtype=jnp.bfloat16)
+
+    def cast_compute(self, x):
+        return x.astype(self.compute_dtype)
+
+    def cast_param(self, x):
+        return x.astype(self.compute_dtype)
+
+
+DEFAULT_POLICY = Policy()

@@ -1,0 +1,8 @@
+"""Shared tiling helpers for the Pallas TPU kernels."""
+
+from __future__ import annotations
+
+
+def round_up(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``n``."""
+    return ((n + m - 1) // m) * m

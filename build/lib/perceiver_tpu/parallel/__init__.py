@@ -1,0 +1,27 @@
+"""Distribution: device meshes, sharding rules, distributed init.
+
+The reference's single strategy is Lightning DDP over NCCL
+(``scripts/trainer.yaml:47``; SURVEY §2.5). Here distribution is
+declarative: a ``jax.sharding.Mesh`` with ``('data', 'model')`` axes,
+``NamedSharding`` rules over the parameter pytree, and GSPMD inserting
+the collectives (gradient all-reduce over ICI = the DDP equivalent;
+model-axis sharding covers the v5p-16 tensor-parallel config).
+"""
+
+from perceiver_tpu.parallel.mesh import make_mesh, distributed_init  # noqa: F401
+from perceiver_tpu.parallel.ring_attention import (  # noqa: F401
+    make_ring_attention,
+    make_seq_parallel_cross_attention,
+    ring_attention,
+    seq_parallel_cross_attention,
+)
+from perceiver_tpu.parallel.ulysses import (  # noqa: F401
+    make_ulysses_attention,
+    ulysses_attention,
+)
+from perceiver_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    param_sharding,
+    seq_sharding,
+    shard_params,
+)

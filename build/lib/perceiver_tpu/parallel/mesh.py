@@ -1,0 +1,64 @@
+"""Mesh construction and multi-host initialization."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              model_parallel: int = 1,
+              seq_parallel: int = 1,
+              axis_names: Optional[Sequence[str]] = None
+              ) -> jax.sharding.Mesh:
+    """Mesh of shape (data, [seq,] model).
+
+    ``model_parallel=1, seq_parallel=1`` is pure data parallelism (the
+    reference's DDP equivalent). ``model_parallel>1`` opens the tensor-
+    parallel axis used by the v5p-16 MLM config (BASELINE.md
+    configs[4]); ``seq_parallel>1`` opens a ``seq`` axis for sharding
+    the token/input axis of long sequences (pjit-partitioned attention
+    or the shard_map ring path in ``parallel.ring_attention``). The
+    ``seq`` axis appears in the mesh only when used, so existing
+    ``('data', 'model')`` sharding rules are unaffected otherwise.
+
+    Devices are laid out so the innermost (model, then seq) axes map
+    to adjacent devices — on TPU those share the fastest ICI links,
+    which matters because model/seq-axis collectives (activation
+    all-reduces, kv rotations) are per-layer while data-axis traffic
+    is once per step.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"asked for {n} devices, have {len(devices)}")
+    inner = model_parallel * seq_parallel
+    if n % inner != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallel×seq_parallel="
+            f"{model_parallel}×{seq_parallel}")
+    if seq_parallel > 1:
+        names = tuple(axis_names or ("data", "seq", "model"))
+        shape = (n // inner, seq_parallel, model_parallel)
+    else:
+        names = tuple(axis_names or ("data", "model"))
+        shape = (n // inner, model_parallel)
+    arr = np.array(devices[:n]).reshape(shape)
+    return jax.sharding.Mesh(arr, names)
+
+
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None):
+    """Multi-host bootstrap (SURVEY §5 distributed backend): the
+    ``jax.distributed.initialize`` wrapper replacing torch's
+    process-group/NCCL init. No-op when single-process or when the TPU
+    runtime env vars already describe the topology."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)

@@ -1,0 +1,96 @@
+"""Sharding rules over the parameter pytree.
+
+Tensor-parallel layout for the attention/MLP weights (the Megatron
+split re-expressed as GSPMD specs; SURVEY §2.5 "leave a model axis
+open"):
+
+- q/k/v projection weights ``(in, embed)`` → shard ``embed`` (heads)
+  on the model axis; their biases likewise.
+- attention output projection ``(embed, embed)`` → shard the *input*
+  dim, so the contraction produces a psum over the model axis and the
+  activation returns replicated.
+- MLP fc1 ``(C, H)`` → shard ``H``; fc2 ``(H, C)`` → shard ``H`` (the
+  input dim), same column→row pattern.
+- Per-position output-adapter linears ``(C, V)`` → shard ``V`` (vocab/
+  class logits stay sharded until the loss, where GSPMD inserts the
+  reduction).
+- Embeddings, positional tables, latents, output queries, norms →
+  replicated (small, read-only per step).
+
+Stacked self-attention blocks carry a leading layer axis (lax.scan),
+so specs are computed against *trailing* dims and padded with None.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.tree_util import DictKey, SequenceKey
+
+
+def _names(path) -> list:
+    out = []
+    for k in path:
+        if isinstance(k, DictKey):
+            out.append(str(k.key))
+        elif isinstance(k, SequenceKey):
+            out.append(str(k.idx))
+    return out
+
+
+def _trailing_spec(names, ndim) -> tuple:
+    """Spec for the trailing (non-stacked) dims of a leaf."""
+    leaf = names[-1] if names else ""
+    parent = names[-2] if len(names) > 1 else ""
+    if leaf == "w":
+        if parent in ("q", "k", "v", "fc1"):
+            return (None, "model")
+        if parent in ("out", "fc2"):
+            return ("model", None)
+        if parent == "linear":  # output adapter: shard logits dim
+            return (None, "model")
+    if leaf == "b" and parent in ("q", "k", "v", "fc1"):
+        return ("model",)
+    return ()
+
+
+def param_spec(path, leaf) -> P:
+    names = _names(path)
+    trailing = _trailing_spec(names, leaf.ndim)
+    pad = (None,) * (leaf.ndim - len(trailing))
+    return P(*(pad + trailing)) if trailing else P()
+
+
+def param_sharding(params, mesh: Mesh):
+    """Pytree of NamedShardings matching ``params``."""
+    has_model = "model" in mesh.axis_names and \
+        mesh.shape.get("model", 1) > 1
+
+    def spec(path, leaf):
+        s = param_spec(path, leaf) if has_model else P()
+        return NamedSharding(mesh, s)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_params(params, mesh: Mesh):
+    return jax.device_put(params, param_sharding(params, mesh))
+
+
+def batch_sharding(mesh: Mesh, extra: Optional[tuple] = None):
+    """Batch-axis (data-parallel) sharding for input arrays."""
+    return NamedSharding(mesh, P("data", *(extra or ())))
+
+
+def seq_sharding(mesh: Mesh):
+    """(B, L, ...) sharding with the token axis over the ``seq`` mesh
+    axis — the pjit form of sequence parallelism: GSPMD partitions the
+    encoder's cross-attention over the kv/sequence axis and inserts
+    the softmax-statistics collectives itself (the manual-control
+    alternative is ``ring_attention`` under shard_map)."""
+    if "seq" not in mesh.axis_names:
+        raise ValueError(f"mesh {mesh.axis_names} has no 'seq' axis; "
+                         "build it with make_mesh(..., seq_parallel=N)")
+    return NamedSharding(mesh, P("data", "seq"))

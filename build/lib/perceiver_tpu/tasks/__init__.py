@@ -1,0 +1,7 @@
+"""Task wrappers binding models + losses + metrics (reference L2 layer,
+``perceiver/lightning.py``) — pure-JAX, no framework dependency."""
+
+from perceiver_tpu.tasks.image import ImageClassifierTask  # noqa: F401
+from perceiver_tpu.tasks.text import TextClassifierTask  # noqa: F401
+from perceiver_tpu.tasks.mlm import MaskedLanguageModelTask  # noqa: F401
+from perceiver_tpu.tasks.segmentation import SegmentationTask  # noqa: F401

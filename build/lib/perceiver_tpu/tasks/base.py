@@ -1,0 +1,103 @@
+"""Shared task hparams and loss helpers.
+
+``TaskConfig`` carries the exact hparam surface of the reference's
+``LitModel`` (``lightning.py:29-42``): num_latents=64,
+num_latent_channels=64, 3 encoder layers, 4/4 cross/self heads, 6
+self-attention layers per block, 4 decoder heads, dropout 0.0.
+
+Losses are weighted by the batch's ``valid`` row mask (the input
+pipeline pads final partial batches to keep shapes static; see
+``perceiver_tpu.data.core``), so metrics remain exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.models.masking import IGNORE_INDEX
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskConfig:
+    num_latents: int = 64
+    num_latent_channels: int = 64
+    num_encoder_layers: int = 3
+    num_encoder_cross_attention_heads: int = 4
+    num_encoder_self_attention_heads: int = 4
+    num_encoder_self_attention_layers_per_block: int = 6
+    num_decoder_cross_attention_heads: int = 4
+    dropout: float = 0.0
+    # rematerialize encoder layers on backward (memory ↔ FLOPs trade
+    # for the large configs; see PerceiverEncoder.remat)
+    remat: bool = False
+    # encoder cross-attention kernel (PerceiverEncoder.attention_impl):
+    # None/"einsum", "chunked", "flash", or — given a mesh with a "seq"
+    # axis — the shard_map sequence-parallel impls "seqpar"/"ring"/
+    # "ulysses"
+    attention_impl: Optional[str] = None
+    kv_chunk_size: int = 1024
+
+    @property
+    def latent_shape(self) -> Tuple[int, int]:
+        return (self.num_latents, self.num_latent_channels)
+
+    # input fields whose second axis is the token/sequence axis; token
+    # tasks set this so those arrays ride a 'seq' mesh axis when one
+    # exists (class attribute, not a dataclass field)
+    seq_partition_fields = ()
+
+    def batch_partition(self, name: str, ndim: int, mesh) -> tuple:
+        """Mesh axes to shard an input field's post-batch dims over
+        (the batch axis itself is always sharded over 'data')."""
+        if (mesh is not None and "seq" in mesh.axis_names
+                and name in self.seq_partition_fields and ndim >= 2):
+            return ("seq",)
+        return ()
+
+    def encoder_spmd(self, mesh) -> Optional[tuple]:
+        """(mesh, seq_axis, batch_axis) for the shard_map attention
+        impls, or None for single-device / pure-GSPMD kernels."""
+        if self.attention_impl not in ("seqpar", "ring", "ulysses"):
+            return None
+        if mesh is None or "seq" not in mesh.axis_names:
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} needs a mesh "
+                "with a 'seq' axis (make_mesh(..., seq_parallel=N)); "
+                f"got {None if mesh is None else mesh.axis_names}")
+        return (mesh, "seq", "data" if "data" in mesh.axis_names else None)
+
+
+def masked_mean(values, mask):
+    """Mean of ``values`` where ``mask`` (same leading shape) is set."""
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return (values.astype(jnp.float32) * mask).sum() / denom
+
+
+def cross_entropy(logits, labels, valid=None,
+                  ignore_index: Optional[int] = None):
+    """CE in fp32 with optional row mask and label ignore value."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    safe_labels = jnp.clip(labels, 0)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+
+    mask = jnp.ones(labels.shape, jnp.float32)
+    if ignore_index is not None:
+        mask = mask * (labels != ignore_index)
+    if valid is not None:
+        mask = mask * valid.reshape(valid.shape + (1,) * (labels.ndim - 1))
+    return masked_mean(nll, mask)
+
+
+def accuracy(logits, labels, valid=None):
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels)
+    mask = valid if valid is not None else jnp.ones(labels.shape, bool)
+    return masked_mean(correct, mask)
+
+
+IGNORE = IGNORE_INDEX

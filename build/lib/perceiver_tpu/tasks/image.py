@@ -1,0 +1,60 @@
+"""Image-classification task (reference ``LitImageClassifier``,
+``lightning.py:88-126``): ImageInputAdapter + ClassificationOutputAdapter
+(output channels = latent channels) around PerceiverEncoder/Decoder."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from perceiver_tpu.adapters import (
+    ClassificationOutputAdapter,
+    ImageInputAdapter,
+)
+from perceiver_tpu.models import PerceiverDecoder, PerceiverEncoder, PerceiverIO
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+from perceiver_tpu.tasks.base import TaskConfig, accuracy, cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageClassifierTask(TaskConfig):
+    image_shape: Tuple[int, int, int] = (28, 28, 1)
+    num_classes: int = 10
+    num_frequency_bands: int = 32
+
+    def build(self, mesh=None) -> PerceiverIO:
+        input_adapter = ImageInputAdapter(
+            image_shape=tuple(self.image_shape),
+            num_frequency_bands=self.num_frequency_bands)
+        output_adapter = ClassificationOutputAdapter(
+            num_classes=self.num_classes,
+            num_output_channels=self.num_latent_channels)
+        encoder = PerceiverEncoder(
+            input_adapter=input_adapter,
+            latent_shape=self.latent_shape,
+            num_layers=self.num_encoder_layers,
+            num_cross_attention_heads=self.num_encoder_cross_attention_heads,
+            num_self_attention_heads=self.num_encoder_self_attention_heads,
+            num_self_attention_layers_per_block=(
+                self.num_encoder_self_attention_layers_per_block),
+            dropout=self.dropout,
+            attention_impl=self.attention_impl,
+            kv_chunk_size=self.kv_chunk_size,
+            spmd=self.encoder_spmd(mesh),
+            remat=self.remat)
+        decoder = PerceiverDecoder(
+            output_adapter=output_adapter,
+            latent_shape=self.latent_shape,
+            num_cross_attention_heads=self.num_decoder_cross_attention_heads,
+            dropout=self.dropout)
+        return PerceiverIO(encoder, decoder)
+
+    def loss_and_metrics(self, model, params, batch, *, rng=None,
+                         deterministic: bool = True,
+                         policy: Policy = DEFAULT_POLICY):
+        logits = model.apply(params, batch["image"], rng=rng,
+                             deterministic=deterministic, policy=policy)
+        valid = batch.get("valid")
+        loss = cross_entropy(logits, batch["label"], valid)
+        acc = accuracy(logits, batch["label"], valid)
+        return loss, {"loss": loss, "acc": acc}

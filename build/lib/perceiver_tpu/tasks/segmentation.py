@@ -1,0 +1,181 @@
+"""LArTPC semantic-segmentation task (reference ``LAr_Perceiver``,
+``run.py:72-112``).
+
+Model: ImageInputAdapter(H, W, 1; 32 Fourier bands) → PerceiverEncoder
+(32×64 latents, 3 layers, 3 self-attn layers/block) → PerceiverDecoder
+with one cross-attention head over H·W output queries →
+SemanticSegOutputAdapter (per-pixel class logits; the reference used
+``ClassificationOutputAdapter`` with ``num_outputs=512·512``,
+``run.py:82``). Zero-valued pixels form the encoder pad mask
+(``run.py:107``).
+
+The 512×512 config has 262,144 output queries — the decoder's
+cross-attention is the memory hot spot (SURVEY §7 hard part (a)), so
+the decoder runs with ``query_chunk_size`` by default: output queries
+never attend to each other, making chunking exact.
+
+Loss: class-weighted cross-entropy with background weight 0
+(``run.py:234-237``); metrics: accuracy over non-background pixels and
+per-class accuracies (``run.py:186-197``). The reference's layout
+defect — reshaping (B, H·W, 3) logits as (B, 3, H·W), a scramble where
+a transpose was meant (SURVEY §2.6.4) — is not reproduced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.adapters import ImageInputAdapter, SemanticSegOutputAdapter
+from perceiver_tpu.models import PerceiverDecoder, PerceiverEncoder, PerceiverIO
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+from perceiver_tpu.tasks.base import TaskConfig, masked_mean
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentationTask(TaskConfig):
+    """Defaults mirror ``run.py:76-101`` (32×64 latents, 3 layers,
+    3 self-attn layers/block, 1 decoder head, 64 output channels)."""
+
+    image_shape: Tuple[int, int, int] = (512, 512, 1)
+    num_classes: int = 3
+    num_frequency_bands: int = 32
+    num_latents: int = 32
+    num_latent_channels: int = 64
+    num_encoder_self_attention_layers_per_block: int = 3
+    num_decoder_cross_attention_heads: int = 1
+    num_output_channels: int = 64
+    background_weight: float = 0.0  # run.py:235 weights[0] = 0
+    query_chunk_size: Optional[int] = 16384
+
+    @property
+    def num_pixels(self) -> int:
+        return self.image_shape[0] * self.image_shape[1]
+
+    def build(self, mesh=None) -> PerceiverIO:
+        input_adapter = ImageInputAdapter(
+            image_shape=tuple(self.image_shape),
+            num_frequency_bands=self.num_frequency_bands)
+        output_adapter = SemanticSegOutputAdapter(
+            num_classes=self.num_classes,
+            num_outputs=self.num_pixels,
+            num_output_channels=self.num_output_channels)
+        encoder = PerceiverEncoder(
+            input_adapter=input_adapter,
+            latent_shape=self.latent_shape,
+            num_layers=self.num_encoder_layers,
+            num_cross_attention_heads=self.num_encoder_cross_attention_heads,
+            num_self_attention_heads=self.num_encoder_self_attention_heads,
+            num_self_attention_layers_per_block=(
+                self.num_encoder_self_attention_layers_per_block),
+            dropout=self.dropout,
+            attention_impl=self.attention_impl,
+            kv_chunk_size=self.kv_chunk_size,
+            spmd=self.encoder_spmd(mesh),
+            remat=self.remat)
+        chunk = self.query_chunk_size
+        if chunk is not None and self.num_pixels % chunk != 0:
+            chunk = None  # tiny test configs: fall back to unchunked
+        decoder = PerceiverDecoder(
+            output_adapter=output_adapter,
+            latent_shape=self.latent_shape,
+            num_cross_attention_heads=self.num_decoder_cross_attention_heads,
+            dropout=self.dropout,
+            query_chunk_size=chunk)
+        return PerceiverIO(encoder, decoder)
+
+    def forward(self, model, params, images, *, rng=None,
+                deterministic: bool = True,
+                policy: Policy = DEFAULT_POLICY):
+        """``images``: (B, H, W) or (B, H, W, 1) wire images. Returns
+        (B, H·W, num_classes) logits. Pad mask = zero pixels."""
+        b = images.shape[0]
+        x = images.reshape(b, *self.image_shape)
+        pad_mask = (x == 0.0).reshape(b, self.num_pixels)
+        return model.apply(params, x, pad_mask, rng=rng,
+                           deterministic=deterministic, policy=policy)
+
+    def class_weights(self) -> jnp.ndarray:
+        w = jnp.ones((self.num_classes,), jnp.float32)
+        return w.at[0].set(self.background_weight)
+
+    def loss_and_metrics(self, model, params, batch, *, rng=None,
+                         deterministic: bool = True,
+                         policy: Policy = DEFAULT_POLICY):
+        logits = self.forward(model, params, batch["image"], rng=rng,
+                              deterministic=deterministic, policy=policy)
+        labels = batch["label"].reshape(logits.shape[0], -1)
+        return segmentation_loss_and_metrics(
+            logits, labels, self.class_weights(), batch.get("valid"))
+
+
+def segmentation_loss_and_metrics(logits, labels, class_weights,
+                                  valid=None):
+    """Class-weighted CE + per-class accuracies over flattened pixels.
+
+    ``logits`` (B, P, C); ``labels`` (B, P). torch
+    ``F.cross_entropy(weight=w)`` semantics (run.py:234-237): per-pixel
+    nll scaled by ``w[label]``, normalized by the summed weights.
+    Shared by the Perceiver and U-ResNet segmentation paths.
+    """
+    num_classes = logits.shape[-1]
+    row = (valid.astype(jnp.float32)[:, None] if valid is not None
+           else jnp.ones((logits.shape[0], 1), jnp.float32))
+
+    logsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logsm, labels[..., None], -1)[..., 0]
+    w = class_weights[labels] * row
+    loss = (nll * w).sum() / jnp.maximum(w.sum(), 1e-8)
+
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels).astype(jnp.float32)
+    metrics = {"loss": loss,
+               "acc": masked_mean(correct, (labels > 0) * row)}
+    for c in range(1, num_classes):
+        metrics[f"acc{c}"] = masked_mean(correct, (labels == c) * row)
+    return loss, metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class UResNetSegmentationTask:
+    """Dense-conv alternative to the Perceiver segmentation model: the
+    U-ResNet the reference wires into ``LAr_Perceiver`` but never runs
+    (``run.py:103,109-110``; SURVEY §2.3) — here a first-class, actually
+    trainable choice (``run.py --model uresnet``).
+
+    ``loss_and_metrics`` returns ``(loss, metrics, new_state)``: the
+    third element is the updated BatchNorm running-stat pytree, which
+    the caller threads (it must not receive optimizer updates).
+    """
+
+    image_shape: Tuple[int, int, int] = (512, 512, 1)
+    num_classes: int = 3
+    inplanes: int = 16
+    background_weight: float = 0.0
+
+    def build(self, mesh=None):
+        del mesh  # dense conv net: GSPMD batch sharding only
+        from perceiver_tpu.models.uresnet import UResNet
+        return UResNet(num_classes=self.num_classes,
+                       input_channels=self.image_shape[-1],
+                       inplanes=self.inplanes)
+
+    def class_weights(self) -> jnp.ndarray:
+        w = jnp.ones((self.num_classes,), jnp.float32)
+        return w.at[0].set(self.background_weight)
+
+    def loss_and_metrics(self, model, variables, batch, *,
+                         train: bool = False,
+                         policy: Policy = DEFAULT_POLICY):
+        b = batch["image"].shape[0]
+        x = batch["image"].reshape(b, *self.image_shape)
+        logits, new_state = model.apply(variables, x, train=train,
+                                        policy=policy)
+        loss, metrics = segmentation_loss_and_metrics(
+            logits.reshape(b, -1, self.num_classes),
+            batch["label"].reshape(b, -1).astype(jnp.int32),
+            self.class_weights(), batch.get("valid"))
+        return loss, metrics, new_state

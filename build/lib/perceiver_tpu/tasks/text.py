@@ -1,0 +1,79 @@
+"""Text-classification task (reference ``LitTextClassifier``,
+``lightning.py:129-171``): reuses the MLM encoder builder; supports
+transfer learning from an MLM checkpoint (encoder-subtree restore) or a
+classifier checkpoint (full restore), plus encoder freezing."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from perceiver_tpu.adapters import ClassificationOutputAdapter
+from perceiver_tpu.models import PerceiverDecoder, PerceiverIO
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+from perceiver_tpu.tasks.base import TaskConfig, accuracy, cross_entropy
+from perceiver_tpu.tasks.mlm import create_encoder
+
+
+@dataclasses.dataclass(frozen=True)
+class TextClassifierTask(TaskConfig):
+    num_classes: int = 2
+    vocab_size: int = 10003
+    max_seq_len: int = 512
+    freeze_encoder: bool = False
+    mlm_ckpt: Optional[str] = None
+    clf_ckpt: Optional[str] = None
+
+    # same token layout as the MLM task (shared encoder)
+    seq_partition_fields = ("input_ids", "pad_mask")
+
+    def build(self, mesh=None) -> PerceiverIO:
+        encoder = create_encoder(self, self.vocab_size, self.max_seq_len,
+                                 mesh=mesh)
+        output_adapter = ClassificationOutputAdapter(
+            num_classes=self.num_classes,
+            num_output_channels=self.num_latent_channels)
+        decoder = PerceiverDecoder(
+            output_adapter=output_adapter,
+            latent_shape=self.latent_shape,
+            num_cross_attention_heads=self.num_decoder_cross_attention_heads,
+            dropout=self.dropout)
+        return PerceiverIO(encoder, decoder)
+
+    def restore_pretrained(self, params):
+        """Apply mlm_ckpt/clf_ckpt transfer (lightning.py:144-149):
+        mlm_ckpt → copy the encoder subtree; clf_ckpt → whole model."""
+        from perceiver_tpu.training.checkpoint import restore_params
+        if self.mlm_ckpt is not None:
+            # cross-model restore (MLM decoder ≠ classifier decoder):
+            # untyped metadata restore, then take the encoder subtree
+            mlm_params = restore_params(self.mlm_ckpt)
+            return {**params, "encoder": mlm_params["encoder"]}
+        if self.clf_ckpt is not None:
+            # same model — typed restore against our own params
+            return restore_params(self.clf_ckpt, template=params)
+        return params
+
+    def frozen_param_labels(self, params):
+        """'frozen'/'trainable' label pytree for optax.multi_transform —
+        the functional equivalent of ``freeze(self.model.encoder)``
+        (lightning.py:151-152, utils.py:17-19)."""
+        import jax
+        if not self.freeze_encoder:
+            return jax.tree.map(lambda _: "trainable", params)
+        return {
+            "encoder": jax.tree.map(lambda _: "frozen", params["encoder"]),
+            "decoder": jax.tree.map(lambda _: "trainable",
+                                    params["decoder"]),
+        }
+
+    def loss_and_metrics(self, model, params, batch, *, rng=None,
+                         deterministic: bool = True,
+                         policy: Policy = DEFAULT_POLICY):
+        logits = model.apply(params, batch["input_ids"], batch["pad_mask"],
+                             rng=rng, deterministic=deterministic,
+                             policy=policy)
+        valid = batch.get("valid")
+        loss = cross_entropy(logits, batch["label"], valid)
+        acc = accuracy(logits, batch["label"], valid)
+        return loss, {"loss": loss, "acc": acc}

@@ -1,0 +1,25 @@
+"""WordPiece tokenizer subsystem.
+
+The reference delegates to the Rust HuggingFace ``tokenizers`` library
+(``perceiver/tokenizer.py``). Here the tokenizer is implemented natively:
+a C++ core (normalize / pre-tokenize / WordPiece encode / decode / train)
+exposed over ctypes, with a pure-Python engine sharing the same JSON
+vocabulary format for environments without the compiled extension.
+"""
+
+from perceiver_tpu.tokenizer.vocab import (  # noqa: F401
+    PAD_TOKEN,
+    PAD_TOKEN_ID,
+    UNK_TOKEN,
+    UNK_TOKEN_ID,
+    MASK_TOKEN,
+    MASK_TOKEN_ID,
+    SPECIAL_TOKENS,
+)
+from perceiver_tpu.tokenizer.wordpiece import (  # noqa: F401
+    WordPieceTokenizer,
+    create_tokenizer,
+    load_tokenizer,
+    save_tokenizer,
+    train_tokenizer,
+)

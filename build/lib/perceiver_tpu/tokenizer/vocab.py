@@ -1,0 +1,12 @@
+"""Special-token constants (reference ``perceiver/tokenizer.py:10-19``)."""
+
+PAD_TOKEN = "[PAD]"
+PAD_TOKEN_ID = 0
+
+UNK_TOKEN = "[UNK]"
+UNK_TOKEN_ID = 1
+
+MASK_TOKEN = "[MASK]"
+MASK_TOKEN_ID = 2
+
+SPECIAL_TOKENS = [PAD_TOKEN, UNK_TOKEN, MASK_TOKEN]

@@ -429,12 +429,8 @@ class WordPieceTokenizer:
         }
 
     def save(self, path: str):
-        # atomic publish: concurrent readers (multi-host shared cache
-        # dirs) must never see a truncated JSON
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
+        with open(path, "w", encoding="utf-8") as f:
             json.dump(self.to_json(), f, ensure_ascii=False)
-        os.replace(tmp, path)
 
     @classmethod
     def from_file(cls, path: str) -> "WordPieceTokenizer":

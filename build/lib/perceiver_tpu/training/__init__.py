@@ -1,0 +1,5 @@
+"""Training engine: optimizers, train state, checkpointing, trainer."""
+
+from perceiver_tpu.training.state import TrainState  # noqa: F401
+from perceiver_tpu.training.optim import create_optimizer  # noqa: F401
+from perceiver_tpu.training.trainer import Trainer, TrainerConfig  # noqa: F401

@@ -1,0 +1,138 @@
+"""Checkpoint save/restore on orbax (SURVEY §5 checkpoint/resume).
+
+Covers the reference's three mechanisms:
+
+1. Best-k retention monitored on ``val_loss`` (Lightning
+   ``ModelCheckpoint``, ``trainer.yaml:10-14``) with hparams embedded —
+   ``CheckpointHook``.
+2. Cross-task transfer restore (``lightning.py:144-149``):
+   ``restore_params(path)`` loads a checkpoint's params pytree so a
+   task can graft the encoder subtree or the whole model.
+3. Manual one-shot save/load (``run.py:278-281``): ``save_params``.
+
+Orbax writes are async-capable and multi-host-safe (each host writes
+its shard), which is the TPU-native answer to preemption: frequent
+cheap checkpoints instead of elastic recovery (the reference has none
+either, SURVEY §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from perceiver_tpu.training.state import TrainState
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))
+
+
+class CheckpointHook:
+    """val_loss-monitored best-k checkpointing of the full TrainState."""
+
+    def __init__(self, directory: str, max_to_keep: int = 1,
+                 monitor: str = "val_loss", mode: str = "min",
+                 hparams: Optional[dict] = None):
+        self.directory = _abs(directory)
+        self.monitor = monitor
+        best_fn = (lambda m: m[monitor]) if monitor else None
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                best_fn=best_fn,
+                best_mode=mode,
+                enable_async_checkpointing=True))
+        if hparams is not None:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(os.path.join(self.directory, "hparams.json"),
+                      "w") as f:
+                json.dump(hparams, f, indent=2, default=str)
+
+    def save(self, step: int, state: TrainState, metrics: dict):
+        metrics = {k: float(v) for k, v in metrics.items()}
+        self._mgr.save(step, args=ocp.args.StandardSave(
+            {"params": state.params, "opt_state": state.opt_state,
+             "rng": jax.random.key_data(state.rng), "step": state.step}),
+            metrics=metrics)
+
+    def restore_latest(self, template_state: TrainState
+                       ) -> Optional[TrainState]:
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self.restore(step, template_state)
+
+    def restore(self, step: int, template_state: TrainState) -> TrainState:
+        template = {
+            "params": template_state.params,
+            "opt_state": template_state.opt_state,
+            "rng": jax.random.key_data(template_state.rng),
+            "step": template_state.step,
+        }
+        got = self._mgr.restore(step,
+                                args=ocp.args.StandardRestore(template))
+        return TrainState(params=got["params"],
+                          opt_state=got["opt_state"],
+                          rng=jax.random.wrap_key_data(got["rng"]),
+                          step=got["step"])
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+
+def save_params(path: str, params: Any, hparams: Optional[dict] = None):
+    """One-shot params save (the ``run.py:278-281`` analogue).
+    Overwrites like ``torch.save`` — a rerun into the same directory
+    must not crash at the end of training."""
+    path = _abs(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, "params"), params, force=True)
+    if hparams is not None:
+        with open(os.path.join(path, "hparams.json"), "w") as f:
+            json.dump(hparams, f, indent=2, default=str)
+
+
+def restore_params(path: str, template: Any = None) -> Any:
+    """Load a params pytree from either a ``save_params`` directory or a
+    ``CheckpointHook`` step directory (transfer-learning source,
+    ``lightning.py:144-149``). ``template`` (a params pytree) pins
+    shapes/dtypes for a safe typed restore; without it orbax falls back
+    to the on-disk metadata."""
+    path = _abs(path)
+    # (checkpoint dir, template shape): save_params stores the bare
+    # params tree; CheckpointHook steps store {params, opt_state, ...}
+    # — only params is restored from those (partial restore)
+    candidates = [(os.path.join(path, "params"), False)]
+    if os.path.isdir(path):
+        # CheckpointHook layout: <dir>/<step>/default/... → pick best/latest
+        steps = sorted(int(d) for d in os.listdir(path) if d.isdigit())
+        candidates += [(os.path.join(path, str(s), "default"), True)
+                       for s in reversed(steps)]
+    for c, wrapped in candidates:
+        if not os.path.isdir(c):
+            continue
+        if template is not None and wrapped:
+            # hook layout stores {params, opt_state, rng, step}; only
+            # params is wanted (and only its template is available)
+            item = {"params": template}
+            with ocp.PyTreeCheckpointer() as ckptr:
+                got = ckptr.restore(c, args=ocp.args.PyTreeRestore(
+                    item=item,
+                    restore_args=ocp.checkpoint_utils
+                    .construct_restore_args(item),
+                    partial_restore=True))
+        else:
+            with ocp.StandardCheckpointer() as ckptr:
+                got = ckptr.restore(c, template)
+        return got.get("params", got) if isinstance(got, dict) \
+            else got
+    raise FileNotFoundError(f"No checkpoint found under {path}")

@@ -1,0 +1,109 @@
+"""Optimizer/scheduler factory mapping the reference's init-dict config
+onto optax.
+
+The reference instantiates optimizers and per-step LR schedulers from
+``optimizer_init``/``scheduler_init`` dicts of the LightningCLI
+``{"class_path": ..., "init_args": {...}}`` form
+(``lightning.py:44-55``; AdamW registered at ``cli.py:43``, OneCycleLR
+at ``mlm.py:14-16``). This module accepts the same dicts and builds the
+optax chain: schedule → clip → AdamW → (freeze mask) → (grad
+accumulation).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import optax
+
+
+def _cls_name(class_path: str) -> str:
+    return class_path.rsplit(".", 1)[-1]
+
+
+def build_schedule(scheduler_init: Optional[dict],
+                   base_lr: float,
+                   max_steps: Optional[int] = None):
+    """LR schedule from a scheduler_init dict; constant if None.
+
+    OneCycleLR maps onto ``optax.cosine_onecycle_schedule`` — identical
+    math to torch's cosine-annealed OneCycle (default pct_start 0.3,
+    div_factor 25, final_div_factor 1e4).
+    """
+    if scheduler_init is None:
+        return base_lr
+    name = _cls_name(scheduler_init.get("class_path", ""))
+    args = dict(scheduler_init.get("init_args", {}))
+    if name == "OneCycleLR":
+        total = args.get("total_steps") or max_steps
+        if not total or total <= 0:
+            raise ValueError(
+                "OneCycleLR needs total_steps (or trainer max_steps)")
+        return optax.cosine_onecycle_schedule(
+            transition_steps=total,
+            peak_value=args.get("max_lr", base_lr),
+            pct_start=args.get("pct_start", 0.3),
+            div_factor=args.get("div_factor", 25.0),
+            final_div_factor=args.get("final_div_factor", 1e4))
+    if name in ("CosineAnnealingLR", "cosine"):
+        total = args.get("T_max") or max_steps
+        return optax.cosine_decay_schedule(
+            init_value=base_lr, decay_steps=total,
+            alpha=args.get("eta_min", 0.0) / max(base_lr, 1e-12))
+    if name in ("StepLR",):
+        return optax.exponential_decay(
+            init_value=base_lr, transition_steps=args.get("step_size", 1),
+            decay_rate=args.get("gamma", 0.1), staircase=True)
+    raise ValueError(f"Unsupported scheduler: {name}")
+
+
+def create_optimizer(
+        optimizer_init: Optional[dict] = None,
+        scheduler_init: Optional[dict] = None,
+        max_steps: Optional[int] = None,
+        gradient_clip_val: float = 0.0,
+        accumulate_grad_batches: int = 1,
+        param_labels=None,
+) -> Tuple[optax.GradientTransformation, Callable[[int], float]]:
+    """Returns ``(tx, lr_fn)``; ``lr_fn(step)`` is for LR logging (the
+    reference's LearningRateMonitor, ``trainer.yaml:6-9``).
+
+    ``param_labels``: optional pytree (or callable params→pytree) of
+    'trainable'/'frozen' labels implementing encoder freezing
+    (``lightning.py:151-152``) via zeroed updates.
+    """
+    optimizer_init = optimizer_init or {
+        "class_path": "AdamW", "init_args": {"lr": 1e-3}}
+    name = _cls_name(optimizer_init.get("class_path", "AdamW"))
+    args = dict(optimizer_init.get("init_args", {}))
+    lr = args.get("lr", args.get("learning_rate", 1e-3))
+    schedule = build_schedule(scheduler_init, lr, max_steps)
+
+    betas = tuple(args.get("betas", (0.9, 0.999)))
+    if name == "AdamW":
+        opt = optax.adamw(schedule, b1=betas[0], b2=betas[1],
+                          eps=args.get("eps", 1e-8),
+                          weight_decay=args.get("weight_decay", 1e-2))
+    elif name == "Adam":
+        opt = optax.adam(schedule, b1=betas[0], b2=betas[1],
+                         eps=args.get("eps", 1e-8))
+    elif name == "SGD":
+        opt = optax.sgd(schedule, momentum=args.get("momentum", 0.0),
+                        nesterov=args.get("nesterov", False))
+    else:
+        raise ValueError(f"Unsupported optimizer: {name}")
+
+    chain = []
+    if gradient_clip_val and gradient_clip_val > 0:
+        chain.append(optax.clip_by_global_norm(gradient_clip_val))
+    chain.append(opt)
+    tx = optax.chain(*chain)
+
+    if param_labels is not None:
+        tx = optax.multi_transform(
+            {"trainable": tx, "frozen": optax.set_to_zero()}, param_labels)
+    if accumulate_grad_batches > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accumulate_grad_batches)
+
+    lr_fn = schedule if callable(schedule) else (lambda _: schedule)
+    return tx, lr_fn

@@ -1,0 +1,28 @@
+"""Train state: a registered-dataclass pytree.
+
+The whole state (params, optimizer state, step, PRNG key) is one pytree
+so it jits, donates, shards, and checkpoints as a unit — the JAX
+analogue of Lightning's module+optimizer+global_step bundle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    rng: Any
+    step: jax.Array
+
+    @staticmethod
+    def create(params, opt_state, rng) -> "TrainState":
+        return TrainState(params=params, opt_state=opt_state, rng=rng,
+                          step=jnp.zeros((), jnp.int32))

@@ -398,15 +398,10 @@ class Trainer:
         if jax.process_count() <= 1:
             self.datamodule.prepare_data()
             return
-        try:
-            if jax.process_index() == 0:
-                self.datamodule.prepare_data()
-        finally:
-            # reach the barrier even when process 0 raised — otherwise
-            # every other host hangs in the sync forever instead of the
-            # fleet failing fast
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("prepare_data")
+        if jax.process_index() == 0:
+            self.datamodule.prepare_data()
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("prepare_data")
 
     def _fit(self) -> TrainState:
         cfg = self.config

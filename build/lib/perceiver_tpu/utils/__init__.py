@@ -1,0 +1,1 @@
+"""Host-side utilities: TB-compatible logging, config, freezing."""

@@ -1,0 +1,110 @@
+"""Step-FLOPs estimation and MFU computation.
+
+The reference never measures throughput or efficiency (SURVEY §6); the
+rebuild's north-star metric is MFU (BASELINE.md: ≥40% on v5e-8 for MLM
+pretraining), so the trainer and bench report it directly.
+
+FLOPs come from XLA's own HLO cost analysis of the lowered step
+(``Lowered.cost_analysis()`` — tracing+lowering only, no extra compile,
+and matmul FLOPs are invariant under XLA's later optimization passes).
+Peak chip FLOP/s is resolved from the device kind; unknown hardware
+(e.g. the CPU test backend) yields ``None`` and callers skip the MFU
+scalar rather than report garbage.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+# bf16 (MXU) peak FLOP/s per chip, by device-kind substring.
+# Sources: public TPU spec sheets (cloud.google.com/tpu/docs/system-
+# architecture-tpu-vm); fp32 runs at roughly 1/2 the bf16 rate on the
+# MXU generations below.
+_PEAK_BF16 = {
+    "v6": 918e12,   # Trillium
+    "v5p": 459e12,
+    "v5e": 197e12,  # v5 lite (v5litepod)
+    "v5lite": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def device_peak_flops(device: Optional[jax.Device] = None,
+                      precision: str = "bf16") -> Optional[float]:
+    """Peak FLOP/s for one chip, or None when unknown (CPU/GPU)."""
+    device = device or jax.devices()[0]
+    if device.platform not in ("tpu", "axon"):
+        return None
+    kind = device.device_kind.lower().replace(" ", "").replace("-", "")
+    for tag, peak in _PEAK_BF16.items():
+        if tag in kind:
+            return peak if precision == "bf16" else peak / 2
+    return None
+
+
+def _flops_of(cost) -> Optional[float]:
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not cost:
+        return None
+    flops = float(cost.get("flops", 0.0))
+    return flops if flops > 0 else None
+
+
+def lowered_step_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """Total FLOPs of one call of ``jitted_fn`` at these arg shapes,
+    from lowering alone (no compile). Returns None on backends that
+    only expose post-compile analysis (e.g. the axon TPU plugin)."""
+    try:
+        return _flops_of(jitted_fn.lower(*args, **kwargs).cost_analysis())
+    except Exception:
+        return None
+
+
+def step_flops_and_fn(jitted_fn, *args, num_devices: int = 1, **kwargs):
+    """Returns ``(global_flops, fn)`` where ``fn`` is what the caller
+    should invoke from now on.
+
+    Prefers lowering-only cost analysis (keeps the original jit fn);
+    the lowered HLO is the pre-partitioning module, so its count is
+    already global. Where that is unavailable, AOT-compiles — the same
+    compile the first jit call would have done, so no double
+    compilation — and takes the analysis from the compiled module.
+    That module is the SPMD-*partitioned* per-device program, so its
+    count is scaled by ``num_devices`` (the devices the computation
+    spans) to stay global. AOT executables require argument shapes and
+    shardings to stay fixed, which the static-shape input pipeline
+    guarantees."""
+    try:
+        lowered = jitted_fn.lower(*args, **kwargs)
+    except Exception:
+        return None, jitted_fn
+    try:
+        flops = _flops_of(lowered.cost_analysis())
+    except Exception:
+        flops = None
+    if flops is not None:
+        return flops, jitted_fn
+    try:
+        compiled = lowered.compile()
+        flops = _flops_of(compiled.cost_analysis())
+        if flops is not None:
+            flops *= max(num_devices, 1)
+        return flops, compiled
+    except Exception:
+        return None, jitted_fn
+
+
+def mfu(flops_per_step: Optional[float], steps: int, seconds: float,
+        num_devices: int = 1,
+        peak_flops_per_device: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1] over a measured interval."""
+    if not flops_per_step or not peak_flops_per_device or seconds <= 0 \
+            or steps <= 0:
+        return None
+    achieved = flops_per_step * steps / seconds
+    return achieved / (peak_flops_per_device * num_devices)

@@ -1,0 +1,155 @@
+"""TensorBoard-compatible event-file writer, dependency-free.
+
+The reference logs scalars and free text through Lightning's
+``TensorBoardLogger`` / ``SummaryWriter`` (``scripts/cli.py:40``,
+``run.py:114``; SURVEY.md §5 metrics). This writer produces the same
+``events.out.tfevents.*`` files — TFRecord framing with masked CRC32C
+checksums around hand-encoded ``tensorflow.Event`` protos — without
+importing TensorFlow or the tensorboard package. Host-side only, never
+on the step path.
+
+Supported summaries: scalars (``add_scalar``) and text
+(``add_text``, rendered by TB's "text" plugin like the reference's
+masked-sample predictions, ``lightning.py:256``).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Union
+
+# --- CRC32C (Castagnoli), table-based ---------------------------------------
+
+_CRC_TABLE = []
+
+
+def _build_table():
+    poly = 0x82F63B78
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        _CRC_TABLE.append(crc)
+
+
+_build_table()
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# --- minimal protobuf wire encoding -----------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _pb_bytes(field: int, data: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(data)) + data
+
+
+def _pb_string(field: int, s: str) -> bytes:
+    return _pb_bytes(field, s.encode("utf-8"))
+
+
+def _pb_double(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _pb_float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _pb_varint(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _scalar_summary(tag: str, value: float) -> bytes:
+    value_msg = _pb_string(1, tag) + _pb_float(2, float(value))
+    return _pb_bytes(1, value_msg)  # Summary.value
+
+
+def _text_summary(tag: str, text: str) -> bytes:
+    plugin_data = _pb_string(1, "text")  # PluginData.plugin_name
+    metadata = _pb_bytes(1, plugin_data)  # SummaryMetadata.plugin_data
+    dim = _pb_varint(1, 1)  # TensorShapeProto.Dim.size = 1
+    shape = _pb_bytes(2, dim)  # TensorProto.tensor_shape
+    tensor = (_pb_varint(1, 7)  # TensorProto.dtype = DT_STRING
+              + shape
+              + _pb_bytes(8, text.encode("utf-8")))  # string_val
+    value_msg = (_pb_string(1, tag)
+                 + _pb_bytes(8, tensor)  # Value.tensor
+                 + _pb_bytes(9, metadata))  # Value.metadata
+    return _pb_bytes(1, value_msg)
+
+
+def _event(step: int, summary: bytes = b"", file_version: str = "") -> bytes:
+    msg = _pb_double(1, time.time())  # Event.wall_time
+    if step:
+        msg += _pb_varint(2, step)  # Event.step
+    if file_version:
+        msg += _pb_string(3, file_version)
+    if summary:
+        msg += _pb_bytes(5, summary)  # Event.summary
+    return msg
+
+
+class SummaryWriter:
+    """Append-only TB event file writer (flushes per record)."""
+
+    def __init__(self, log_dir: Union[str, os.PathLike]):
+        self.log_dir = str(log_dir)
+        os.makedirs(self.log_dir, exist_ok=True)
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}.{os.getpid()}.0")
+        self._f = open(os.path.join(self.log_dir, fname), "ab")
+        self._write_record(_event(0, file_version="brain.Event:2"))
+
+    def _write_record(self, data: bytes):
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", _masked_crc(data)))
+        self._f.flush()
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._write_record(_event(step, _scalar_summary(tag, value)))
+
+    def add_text(self, tag: str, text: str, step: int):
+        self._write_record(_event(step, _text_summary(tag, text)))
+
+    def close(self):
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
