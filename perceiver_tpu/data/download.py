@@ -52,12 +52,18 @@ def extract_tgz(path: str, dest_dir: str) -> bool:
                 tf.extractall(dest_dir, filter="data")
             except TypeError:
                 # filter= landed in 3.10.12/3.11.4; older patch
-                # releases get a manual traversal check instead
+                # releases get a conservative manual check instead:
+                # no links at all (symlink members could redirect
+                # later writes outside dest_dir) and no names
+                # escaping dest_dir ("." itself is fine)
                 base = os.path.realpath(dest_dir)
                 for m in tf.getmembers():
+                    if m.issym() or m.islnk():
+                        raise ValueError(f"link tar member {m.name}")
                     target = os.path.realpath(
                         os.path.join(dest_dir, m.name))
-                    if not target.startswith(base + os.sep):
+                    if not (target == base or
+                            target.startswith(base + os.sep)):
                         raise ValueError(f"unsafe tar member {m.name}")
                 tf.extractall(dest_dir)
         return True

@@ -172,8 +172,13 @@ class IMDBDataModule:
                 ok = extract_tgz(tgz, tmp) and \
                     os.path.isdir(os.path.join(tmp, "aclImdb"))
                 if ok and not os.path.isdir(self.aclimdb_root):
-                    os.replace(os.path.join(tmp, "aclImdb"),
-                               self.aclimdb_root)
+                    try:
+                        os.replace(os.path.join(tmp, "aclImdb"),
+                                   self.aclimdb_root)
+                    except OSError:
+                        # a concurrent extractor published first —
+                        # losing the race is success
+                        pass
                 shutil.rmtree(tmp, ignore_errors=True)
                 if not ok:
                     # a tarball that extracts but has no aclImdb/ root
@@ -192,12 +197,16 @@ class IMDBDataModule:
     def setup(self, stage: Optional[str] = None):
         if self._train is not None:
             return
-        if not os.path.exists(self.tokenizer_path):
-            # standalone use (no Trainer): make setup self-sufficient —
-            # but ONLY when the tokenizer is missing, so multi-host
-            # runs (where Trainer._prepare_data gated the download to
-            # process 0) don't re-enter the download/train path on
-            # every process
+        from perceiver_tpu.data.download import offline
+        if not os.path.exists(self.tokenizer_path) or (
+                not os.path.isdir(self.aclimdb_root) and not offline()):
+            # standalone use (no Trainer): make setup self-sufficient.
+            # Re-enter prepare_data when the tokenizer is missing, and
+            # ALSO when only a synthetic-corpus cache exists but we
+            # might now be able to download the real corpus — a
+            # once-offline run must not pin synthetic data forever.
+            # Offline (env-flagged) multi-host runs still skip the
+            # re-entry, keeping the process-0 download gating effective.
             self.prepare_data()
         self.tokenizer = load_tokenizer(self.tokenizer_path)
         self.collator = Collator(self.tokenizer, self.max_seq_len)
