@@ -19,12 +19,18 @@ def offline() -> bool:
     return os.environ.get("PERCEIVER_TPU_OFFLINE", "") not in ("", "0")
 
 
+# URLs that already failed in this process — retried next process, but
+# never within one (a firewalled host must not stall repeatedly on the
+# same connect timeout during a single run)
+_failed_urls: set = set()
+
+
 def fetch(url: str, dest: str, timeout: float = 15.0) -> bool:
     """Download ``url`` to ``dest`` atomically. False on any failure.
     The temp name is per-process so concurrent callers (multi-host
     runs sharing a data_dir) never interleave writes; last finished
     rename wins, each with a complete file."""
-    if offline():
+    if offline() or url in _failed_urls:
         return False
     tmp = f"{dest}.part.{os.getpid()}"
     try:
@@ -35,6 +41,7 @@ def fetch(url: str, dest: str, timeout: float = 15.0) -> bool:
         os.replace(tmp, dest)
         return True
     except Exception:
+        _failed_urls.add(url)
         try:
             os.unlink(tmp)
         except OSError:
@@ -58,8 +65,10 @@ def extract_tgz(path: str, dest_dir: str) -> bool:
                 # escaping dest_dir ("." itself is fine)
                 base = os.path.realpath(dest_dir)
                 for m in tf.getmembers():
-                    if m.issym() or m.islnk():
-                        raise ValueError(f"link tar member {m.name}")
+                    if not (m.isfile() or m.isdir()):
+                        # no links (could redirect later writes), no
+                        # devices/FIFOs — what filter="data" rejects
+                        raise ValueError(f"special tar member {m.name}")
                     target = os.path.realpath(
                         os.path.join(dest_dir, m.name))
                     if not (target == base or

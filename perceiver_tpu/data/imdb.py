@@ -131,15 +131,18 @@ class IMDBDataModule:
     def aclimdb_root(self) -> str:
         return os.path.join(self.data_dir, "aclImdb")
 
-    @property
-    def tokenizer_path(self) -> str:
+    def _tokenizer_path_for(self, have_corpus: bool) -> str:
         # a tokenizer trained on the synthetic fallback corpus must
         # never be silently reused for the real one (its vocab would
         # map real reviews to [UNK]) — the cache name records which
         # corpus it was trained on
-        tag = "" if os.path.isdir(self.aclimdb_root) else "synthetic-"
+        tag = "" if have_corpus else "synthetic-"
         return os.path.join(
             self.data_dir, f"imdb-tokenizer-{tag}{self.vocab_size}.json")
+
+    @property
+    def tokenizer_path(self) -> str:
+        return self._tokenizer_path_for(os.path.isdir(self.aclimdb_root))
 
     def _raw_train(self) -> Tuple[List[str], List[int]]:
         if os.path.isdir(self.aclimdb_root):
@@ -176,9 +179,13 @@ class IMDBDataModule:
                         os.replace(os.path.join(tmp, "aclImdb"),
                                    self.aclimdb_root)
                     except OSError:
-                        # a concurrent extractor published first —
-                        # losing the race is success
-                        pass
+                        shutil.rmtree(tmp, ignore_errors=True)
+                        if not os.path.isdir(self.aclimdb_root):
+                            # not a lost race — the corpus was never
+                            # published (permissions, read-only fs);
+                            # surface it instead of silently training
+                            # on synthetic data
+                            raise
                 shutil.rmtree(tmp, ignore_errors=True)
                 if not ok:
                     # a tarball that extracts but has no aclImdb/ root
@@ -187,26 +194,35 @@ class IMDBDataModule:
                         os.unlink(tgz)
                     except OSError:
                         pass
-        if os.path.exists(self.tokenizer_path):
+        # snapshot corpus presence ONCE: the corpus choice, the cache
+        # name, and the training text source must agree even if a
+        # concurrent extractor publishes the real corpus mid-function
+        have_corpus = os.path.isdir(self.aclimdb_root)
+        tok_path = self._tokenizer_path_for(have_corpus)
+        if os.path.exists(tok_path):
             return
-        texts, _ = self._raw_train()
+        if have_corpus:
+            texts, _ = load_split(self.aclimdb_root, "train")
+        else:
+            self.synthetic = True
+            texts, _ = _synthetic_reviews(self.synthetic_train_size,
+                                          self.seed)
         tokenizer = create_tokenizer(Replace("<br />", " "))
         train_tokenizer(tokenizer, texts, vocab_size=self.vocab_size)
-        save_tokenizer(tokenizer, self.tokenizer_path)
+        save_tokenizer(tokenizer, tok_path)
 
     def setup(self, stage: Optional[str] = None):
         if self._train is not None:
             return
-        from perceiver_tpu.data.download import offline
-        if not os.path.exists(self.tokenizer_path) or (
-                not os.path.isdir(self.aclimdb_root) and not offline()):
-            # standalone use (no Trainer): make setup self-sufficient.
-            # Re-enter prepare_data when the tokenizer is missing, and
-            # ALSO when only a synthetic-corpus cache exists but we
-            # might now be able to download the real corpus — a
-            # once-offline run must not pin synthetic data forever.
-            # Offline (env-flagged) multi-host runs still skip the
-            # re-entry, keeping the process-0 download gating effective.
+        if not os.path.exists(self.tokenizer_path):
+            # standalone use (no Trainer): make setup self-sufficient —
+            # but ONLY when the tokenizer cache is missing, so
+            # multi-host runs (Trainer gates downloads to process 0)
+            # never re-enter the download path from every process.
+            # Corpus upgrades (offline run cached synthetic, network
+            # returned) happen through prepare_data, which every
+            # Trainer fit invokes and which re-attempts the download
+            # whenever the real corpus is absent.
             self.prepare_data()
         self.tokenizer = load_tokenizer(self.tokenizer_path)
         self.collator = Collator(self.tokenizer, self.max_seq_len)
