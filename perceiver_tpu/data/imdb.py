@@ -144,14 +144,20 @@ class IMDBDataModule:
     def tokenizer_path(self) -> str:
         return self._tokenizer_path_for(os.path.isdir(self.aclimdb_root))
 
-    def _raw_train(self) -> Tuple[List[str], List[int]]:
-        if os.path.isdir(self.aclimdb_root):
+    def _raw_train(self, have_corpus: Optional[bool] = None
+                   ) -> Tuple[List[str], List[int]]:
+        if have_corpus is None:
+            have_corpus = os.path.isdir(self.aclimdb_root)
+        if have_corpus:
             return load_split(self.aclimdb_root, "train")
         self.synthetic = True
         return _synthetic_reviews(self.synthetic_train_size, self.seed)
 
-    def _raw_test(self) -> Tuple[List[str], List[int]]:
-        if os.path.isdir(self.aclimdb_root):
+    def _raw_test(self, have_corpus: Optional[bool] = None
+                  ) -> Tuple[List[str], List[int]]:
+        if have_corpus is None:
+            have_corpus = os.path.isdir(self.aclimdb_root)
+        if have_corpus:
             return load_split(self.aclimdb_root, "test")
         self.synthetic = True
         return _synthetic_reviews(self.synthetic_test_size, self.seed + 1)
@@ -214,7 +220,12 @@ class IMDBDataModule:
     def setup(self, stage: Optional[str] = None):
         if self._train is not None:
             return
-        if not os.path.exists(self.tokenizer_path):
+        # snapshot corpus presence ONCE: the tokenizer cache name and
+        # the text source must describe the same corpus even if a
+        # concurrent extractor publishes aclImdb/ mid-setup
+        have_corpus = os.path.isdir(self.aclimdb_root)
+        tok_path = self._tokenizer_path_for(have_corpus)
+        if not os.path.exists(tok_path):
             # standalone use (no Trainer): make setup self-sufficient —
             # but ONLY when the tokenizer cache is missing, so
             # multi-host runs (Trainer gates downloads to process 0)
@@ -224,11 +235,15 @@ class IMDBDataModule:
             # Trainer fit invokes and which re-attempts the download
             # whenever the real corpus is absent.
             self.prepare_data()
-        self.tokenizer = load_tokenizer(self.tokenizer_path)
+            # prepare_data may have just downloaded the real corpus —
+            # re-snapshot so we train/load against what now exists
+            have_corpus = os.path.isdir(self.aclimdb_root)
+            tok_path = self._tokenizer_path_for(have_corpus)
+        self.tokenizer = load_tokenizer(tok_path)
         self.collator = Collator(self.tokenizer, self.max_seq_len)
 
-        tr_texts, tr_labels = self._raw_train()
-        te_texts, te_labels = self._raw_test()
+        tr_texts, tr_labels = self._raw_train(have_corpus)
+        te_texts, te_labels = self._raw_test(have_corpus)
         y, ids, pad = self.collator.collate(tr_labels, tr_texts)
         self._train = ArrayDataset(label=y, input_ids=ids, pad_mask=pad)
         y, ids, pad = self.collator.collate(te_labels, te_texts)

@@ -149,17 +149,29 @@ class MNISTDataModule:
         paths = {k: _find_idx(self.data_dir, v) for k, v in _FILES.items()}
         loaded = False
         if all(paths.values()):
-            try:
-                xtr = _read_idx(paths["train_images"])
-                ytr = _read_idx(paths["train_labels"]).astype(np.int32)
-                xte = _read_idx(paths["test_images"])
-                yte = _read_idx(paths["test_labels"]).astype(np.int32)
+            arrays = {}
+            for k, p in paths.items():
+                try:
+                    arrays[k] = _read_idx(p)
+                except Exception:
+                    # corrupt cached file → synthetic fallback, never a
+                    # crash (module contract). Unlink it so the next
+                    # prepare_data can re-download instead of being
+                    # permanently short-circuited by _find_idx seeing
+                    # all four names present. Keep validating the rest:
+                    # every corrupt file must be cleared in ONE pass or
+                    # each prepare/setup cycle repairs just one file.
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            if len(arrays) == len(paths):
+                xtr = arrays["train_images"]
+                ytr = arrays["train_labels"].astype(np.int32)
+                xte = arrays["test_images"]
+                yte = arrays["test_labels"].astype(np.int32)
                 val_split = self.val_split
                 loaded = True
-            except Exception:
-                # corrupt local files → synthetic fallback, never a
-                # crash (module contract)
-                loaded = False
         if not loaded:
             self.synthetic = True
             (xtr, ytr), (xte, yte) = _synthetic_mnist(

@@ -498,7 +498,9 @@ class Trainer:
                 # (each host contributes an equal per-host shard to the
                 # global batch), so samples_per_sec reports global
                 # training throughput, consistent with the mfu scalar
-                batch_size = (sum(len(b["valid"]) for b in group)
+                # count only real rows — a non-drop_last loader pads the
+                # final batch with invalid rows that do no training work
+                batch_size = (sum(int(b["valid"].sum()) for b in group)
                               * jax.process_count())
                 prev_step = self.global_step
                 first_step = self._step_flops is None

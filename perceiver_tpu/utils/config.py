@@ -126,10 +126,11 @@ class CLI:
                 _set_dotted(flat, k, v)
             config = _deep_merge(config, flat)
 
-        # --config file contents merge below dotted flags so a flag
-        # overrides a preset value regardless of argv order
-        file_over: dict = {}
-        cli_over: dict = {}
+        # --config file contents and dotted flags merge last-wins in
+        # argv order (reference LightningCLI/jsonargparse semantics:
+        # `--lr=x --config b.yaml` yields b.yaml's value, while
+        # `--config b.yaml --lr=x` yields x)
+        explicit: dict = {}
         i = 1
         while i < len(argv):
             arg = argv[i]
@@ -159,20 +160,19 @@ class CLI:
                 i += 2
             if key == "config":
                 with open(raw) as f:
-                    file_over = _deep_merge(file_over,
-                                            yaml.safe_load(f) or {})
+                    explicit = _deep_merge(explicit,
+                                           yaml.safe_load(f) or {})
             else:
                 val = _parse_value(raw)
                 if key == "data" and isinstance(val, str):
                     # --data=IMDBDataModule selection composes with
                     # --data.* option flags (reference README.md:36)
                     key, val = "data.class_name", val
-                _set_dotted(cli_over, key, val)
-        config = _deep_merge(config, file_over)
-        config = _deep_merge(config, cli_over)
+                _set_dotted(explicit, key, val)
         # everything the user stated explicitly — via --config file or
-        # dotted flag — must suppress parse-time links equally
-        explicit = _deep_merge(file_over, cli_over)
+        # dotted flag — overrides defaults and suppresses parse-time
+        # links equally
+        config = _deep_merge(config, explicit)
 
         # static (parse-time) links — a link only fills values into a
         # group the user actually configured (linking OneCycle args into

@@ -103,3 +103,33 @@ def test_config_file_values_suppress_parse_links(tmp_path):
     init = cli.config["lr_scheduler"]["init_args"]
     assert init["total_steps"] == 5
     assert init["max_lr"] == 0.5
+
+
+def test_cli_overrides_last_wins_in_argv_order(tmp_path):
+    """--config files and dotted flags apply last-wins in argv order
+    (reference LightningCLI/jsonargparse semantics): a flag AFTER the
+    file overrides it, a flag BEFORE the file is overridden by it."""
+    preset = tmp_path / "b.yaml"
+    preset.write_text("optimizer:\n  lr: 0.002\n")
+    mod = _load_script("img_clf")
+    cli = mod.main(args=["fit", "--optimizer.lr=0.5",
+                         "--config", str(preset)], run=False)
+    assert cli.config["optimizer"]["lr"] == 0.002
+    cli = mod.main(args=["fit", "--config", str(preset),
+                         "--optimizer.lr=0.5"], run=False)
+    assert cli.config["optimizer"]["lr"] == 0.5
+
+
+def test_mnist_corrupt_cache_unlinked_for_redownload(tmp_path):
+    """A corrupt cached IDX file must be deleted during setup's
+    fallback so a later prepare_data can re-download it instead of
+    _find_idx short-circuiting on the bad file forever."""
+    from perceiver_tpu.data.mnist import _FILES, MNISTDataModule
+    for base in _FILES.values():
+        (tmp_path / (base + ".gz")).write_bytes(b"not a gzip file")
+    dm = MNISTDataModule(data_dir=str(tmp_path), synthetic_train_size=64,
+                         synthetic_test_size=16)
+    dm.setup()
+    assert dm.synthetic
+    # at least the first corrupt file read was unlinked
+    assert not (tmp_path / ("train-images-idx3-ubyte.gz")).exists()
