@@ -8,23 +8,25 @@
 //     (byte-wise longest match; vocab entries are valid UTF-8, so
 //     mid-codepoint splits can never match and char-boundary semantics
 //     are preserved).
-//   wp_train — likelihood-scored pair-merge training
-//     (score = freq(pair) / (freq(a) * freq(b))) with incremental
-//     pair/symbol-frequency bookkeeping, so training the IMDB corpus
-//     to a 10k vocab is minutes of C++, not hours of Python.
+//   wp_train — count-scored pair-merge training (the HF
+//     WordPieceTrainer algorithm: it wraps BpeTrainer, so merges are
+//     selected by highest raw pair count) with incremental pair
+//     bookkeeping, so training the IMDB corpus to a 10k vocab is
+//     minutes of C++, not hours of Python.
 //
 // Normalization (NFD/lowercase/strip-accents) stays in Python: CPython's
 // unicodedata is already a C extension and it is not on the hot path.
 //
 // Exposed over a plain C ABI for ctypes (no pybind11 in this image).
-// Tie-breaking matches the pure-Python trainer exactly (score desc,
-// then lexicographically smaller pair), so native and fallback engines
-// produce identical vocabularies.
+// Tie-breaking matches the pure-Python trainer exactly (count desc,
+// then lowest (vocab_rank_a, vocab_rank_b)), so native and fallback
+// engines produce identical vocabularies.
 
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <set>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -388,9 +390,9 @@ namespace {
 struct Trainer {
     std::vector<std::string> id_to_sym;          // symbol strings
     std::unordered_map<std::string, int32_t> sym_to_id;
+    std::vector<int32_t> rank;                   // symbol -> vocab index
     std::vector<std::vector<int32_t>> words;     // word -> symbol ids
     std::vector<int64_t> counts;                 // word -> corpus count
-    std::vector<int64_t> sym_freq;               // symbol -> occurrences
     using Pair = std::pair<int32_t, int32_t>;
     std::unordered_map<Pair, int64_t, PairHash> pair_freq;
     std::unordered_map<Pair, std::unordered_set<int32_t>, PairHash>
@@ -402,7 +404,7 @@ struct Trainer {
         int32_t id = static_cast<int32_t>(id_to_sym.size());
         id_to_sym.push_back(s);
         sym_to_id.emplace(s, id);
-        sym_freq.push_back(0);
+        rank.push_back(-1);
         return id;
     }
 
@@ -435,29 +437,41 @@ struct Trainer {
 
 }  // namespace
 
-// Train from unique words + counts. Returns a malloc'd buffer of
-// '\n'-joined vocab tokens in id order (caller frees with wp_free).
+// Train from unique words + counts (HF WordPieceTrainer algorithm:
+// BPE count-scored merges with a continuation prefix — HF's trainer
+// wraps BpeTrainer, so merges are selected by highest raw pair count,
+// ties broken by lowest (vocab_rank_a, vocab_rank_b)). Returns a
+// malloc'd buffer of '\n'-joined vocab tokens in id order (caller
+// frees with wp_free).
 char* wp_train(const char** word_strs, const int64_t* word_counts,
                int32_t n_words, const char** specials, int32_t n_specials,
                const char* prefix, int32_t vocab_size, int64_t min_freq) {
     Trainer tr;
     const std::string pref(prefix);
 
-    // vocab under construction: specials first, then alphabet, then merges
+    // vocab under construction: specials, then the plain-char alphabet
+    // sorted by codepoint (bytewise UTF-8 order == codepoint order),
+    // then ##-continuation forms in word order, then merges — the HF
+    // BpeTrainer vocab layout
     std::vector<std::string> vocab;
     std::unordered_set<std::string> vocab_set;
-    auto add_vocab = [&](const std::string& t) {
-        if (vocab_set.insert(t).second) vocab.push_back(t);
+    auto add_vocab = [&](const std::string& t) -> int32_t {
+        if (vocab_set.insert(t).second) {
+            vocab.push_back(t);
+            return static_cast<int32_t>(vocab.size()) - 1;
+        }
+        return -1;
     };
     for (int32_t i = 0; i < n_specials; ++i) add_vocab(specials[i]);
 
-    // split words into initial symbols (first char plain, rest ##'d)
-    std::map<std::string, size_t> alphabet;  // ordered like sorted(set)
+    // split words into UTF-8 chars once; collect the plain alphabet
+    std::set<std::string> alphabet;
+    std::vector<std::vector<std::string>> word_chars(n_words);
     tr.words.resize(n_words);
     tr.counts.assign(word_counts, word_counts + n_words);
     for (int32_t wi = 0; wi < n_words; ++wi) {
         const std::string w(word_strs[wi]);
-        std::vector<std::string> chars;
+        auto& chars = word_chars[wi];
         size_t i = 0;
         while (i < w.size()) {
             size_t j = i + 1;
@@ -465,40 +479,50 @@ char* wp_train(const char** word_strs, const int64_t* word_counts,
                        == 0x80)
                 ++j;
             chars.push_back(w.substr(i, j - i));
+            alphabet.insert(chars.back());
             i = j;
         }
+    }
+    auto set_rank = [&](int32_t id, int32_t pos) {
+        if (pos >= 0) tr.rank[id] = pos;
+    };
+    for (const auto& c : alphabet) {
+        int32_t id = tr.intern(c);
+        set_rank(id, add_vocab(c));
+    }
+    // tokenize words (first char plain, rest ##'d); unseen ## forms
+    // join the vocab here, in word order
+    for (int32_t wi = 0; wi < n_words; ++wi) {
         auto& syms = tr.words[wi];
+        const auto& chars = word_chars[wi];
         for (size_t k = 0; k < chars.size(); ++k) {
             std::string s = k == 0 ? chars[k] : pref + chars[k];
-            alphabet[s] = 1;
             int32_t id = tr.intern(s);
+            set_rank(id, add_vocab(s));
             syms.push_back(id);
-            tr.sym_freq[id] += tr.counts[wi];
         }
     }
-    for (const auto& kv : alphabet) add_vocab(kv.first);
     for (int32_t wi = 0; wi < n_words; ++wi) tr.add_pairs_of(wi);
 
     const int64_t effective_min = min_freq > 1 ? min_freq : 1;
     while (static_cast<int32_t>(vocab.size()) < vocab_size &&
            !tr.pair_freq.empty()) {
-        // argmax score; tie → lexicographically smaller (a, b)
+        // argmax pair count; tie → lowest (rank_a, rank_b)
         Trainer::Pair best{-1, -1};
-        double best_score = -1.0;
+        int64_t best_count = 0;
         for (const auto& kv : tr.pair_freq) {
             if (kv.second < effective_min) continue;
-            double score = static_cast<double>(kv.second) /
-                (static_cast<double>(tr.sym_freq[kv.first.first]) *
-                 static_cast<double>(tr.sym_freq[kv.first.second]));
-            if (score > best_score) {
+            bool better = kv.second > best_count;
+            if (!better && kv.second == best_count && best.first >= 0) {
+                int32_t ra1 = tr.rank[kv.first.first];
+                int32_t rb1 = tr.rank[kv.first.second];
+                int32_t ra0 = tr.rank[best.first];
+                int32_t rb0 = tr.rank[best.second];
+                better = ra1 < ra0 || (ra1 == ra0 && rb1 < rb0);
+            }
+            if (better) {
                 best = kv.first;
-                best_score = score;
-            } else if (score == best_score && best.first >= 0) {
-                const std::string& a1 = tr.id_to_sym[kv.first.first];
-                const std::string& b1 = tr.id_to_sym[kv.first.second];
-                const std::string& a0 = tr.id_to_sym[best.first];
-                const std::string& b0 = tr.id_to_sym[best.second];
-                if (a1 < a0 || (a1 == a0 && b1 < b0)) best = kv.first;
+                best_count = kv.second;
             }
         }
         if (best.first < 0) break;
@@ -508,7 +532,7 @@ char* wp_train(const char** word_strs, const int64_t* word_counts,
         std::string merged = a + (b.rfind(pref, 0) == 0
                                   ? b.substr(pref.size()) : b);
         int32_t merged_id = tr.intern(merged);
-        add_vocab(merged);
+        set_rank(merged_id, add_vocab(merged));
 
         // rewrite only the words containing the merged pair
         auto affected_it = tr.pair_words.find(best);
@@ -525,9 +549,6 @@ char* wp_train(const char** word_strs, const int64_t* word_counts,
                 if (j + 1 < syms.size() && syms[j] == best.first &&
                     syms[j + 1] == best.second) {
                     out.push_back(merged_id);
-                    tr.sym_freq[best.first] -= tr.counts[wi];
-                    tr.sym_freq[best.second] -= tr.counts[wi];
-                    tr.sym_freq[merged_id] += tr.counts[wi];
                     j += 2;
                 } else {
                     out.push_back(syms[j]);

@@ -467,11 +467,15 @@ class WordPieceTokenizer:
 
 @dataclasses.dataclass
 class WordPieceTrainer:
-    """Likelihood-scored merge training (HF WordPieceTrainer algorithm).
+    """Count-scored merge training (HF WordPieceTrainer algorithm).
 
-    Builds the initial alphabet (plain and ``##``-prefixed forms), then
-    repeatedly merges the adjacent pair maximizing
-    ``freq(pair) / (freq(a) * freq(b))`` until ``vocab_size``.
+    HF's ``WordPieceTrainer`` wraps ``BpeTrainer`` with a ``##``
+    continuation prefix: merges are selected by highest raw pair
+    *count* (not the likelihood score of the original WordPiece
+    paper), ties broken by the lowest (id_a, id_b) in vocab order.
+    Vocab construction order also follows HF: special tokens, then the
+    plain-character alphabet sorted by codepoint, then ``##``-prefixed
+    continuation forms in word order, then merges.
     """
 
     vocab_size: int
@@ -498,49 +502,45 @@ class WordPieceTrainer:
         prefix = tokenizer.prefix
 
         word_counts: Counter = count_words(tokenizer, data)
+        # sorted word order: deterministic, and identical to the input
+        # order the native trainer receives (native.py sorts too)
+        ordered = sorted(word_counts)
 
         vocab: dict = {}
         for t in self.special_tokens:
-            vocab[t] = len(vocab)
+            vocab.setdefault(t, len(vocab))
+        # HF vocab order: plain alphabet chars sorted by codepoint ...
+        for c in sorted({c for w in ordered for c in w}):
+            vocab.setdefault(c, len(vocab))
+        # ... then ##-continuation forms in word order
+        words = {}
+        for w in ordered:
+            syms = [w[0]] + [prefix + c for c in w[1:]]
+            for s in syms:
+                vocab.setdefault(s, len(vocab))
+            words[w] = syms
 
-        # Initial alphabet: first chars plain, continuation chars ##'d.
-        alphabet = set()
-        for w in word_counts:
-            alphabet.add(w[0])
-            alphabet.update(prefix + c for c in w[1:])
-        for s in sorted(alphabet):
-            if s not in vocab:
-                vocab[s] = len(vocab)
-
-        # Each word as a list of current symbols.
-        words = {w: [w[0]] + [prefix + c for c in w[1:]]
-                 for w in word_counts}
-
+        min_f = max(self.min_frequency, 1)
         while len(vocab) < self.vocab_size:
             pair_freq: Counter = Counter()
-            sym_freq: Counter = Counter()
             for w, syms in words.items():
                 c = word_counts[w]
-                for s in syms:
-                    sym_freq[s] += c
                 for a, b in zip(syms, syms[1:]):
                     pair_freq[(a, b)] += c
-            if not pair_freq:
-                break
-            best, best_score = None, None
+            best, best_f = None, 0
             for pair, f in pair_freq.items():
-                if f < max(self.min_frequency, 1):
+                if f < min_f:
                     continue
-                score = f / (sym_freq[pair[0]] * sym_freq[pair[1]])
-                if best_score is None or score > best_score or (
-                        score == best_score and pair < best):
-                    best, best_score = pair, score
+                if f > best_f or (
+                        f == best_f
+                        and (vocab[pair[0]], vocab[pair[1]])
+                        < (vocab[best[0]], vocab[best[1]])):
+                    best, best_f = pair, f
             if best is None:
                 break
             a, b = best
             merged = a + (b[len(prefix):] if b.startswith(prefix) else b)
-            if merged not in vocab:
-                vocab[merged] = len(vocab)
+            vocab.setdefault(merged, len(vocab))
             for w, syms in words.items():
                 j, out = 0, []
                 while j < len(syms):
