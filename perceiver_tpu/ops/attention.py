@@ -83,7 +83,8 @@ def mha_apply(params, q, k, v, *, num_heads: int,
     key_padding_mask: (B, Lk) bool, True at padding.
     attn_mask: (Lq, Lk) or (B, Lq, Lk); bool (True = masked) or additive.
     impl: None/"einsum" (materialized weights, supports dropout and
-    attn_mask), "chunked" (blockwise lax.scan, O(Lq·chunk) memory),
+    attn_mask), "chunked" (blockwise lax.scan, O(Lq·chunk) memory,
+    supports streamed attention dropout),
     "flash" (fused Pallas TPU kernel; interpreter mode off-TPU), or one
     of the shard_map sequence-parallel kernels — "seqpar" (q replicated,
     kv sequence-sharded: the Perceiver cross-attention layout), "ring"
@@ -102,10 +103,11 @@ def mha_apply(params, q, k, v, *, num_heads: int,
             raise NotImplementedError(
                 f"impl={impl!r} supports key_padding_mask only, "
                 "not attn_mask")
-        if dropout_rate > 0.0 and not deterministic:
+        if (impl != "chunked" and dropout_rate > 0.0
+                and not deterministic):
             raise NotImplementedError(
                 f"impl={impl!r} does not support attention-weight "
-                "dropout; use the einsum impl")
+                "dropout; use the einsum or chunked impl")
     if impl in _SPMD_IMPLS and spmd is None:
         raise ValueError(
             f"impl={impl!r} needs spmd=(mesh, seq_axis, batch_axis)")
@@ -142,8 +144,15 @@ def mha_apply(params, q, k, v, *, num_heads: int,
         qt, kt, vt = (x.swapaxes(1, 2) for x in (qh, kh, vh))
         scale = 1.0 / (head_dim ** 0.5)
         if impl == "chunked":
+            drop = dropout_rate if not deterministic else 0.0
+            if drop > 0.0 and rng is None:
+                # mirror the einsum path (ops/dropout.py): silently
+                # skipping configured dropout would be invisible
+                raise ValueError("dropout needs an rng when not "
+                                 "deterministic")
             out = _ca.chunked_attention(qt, kt, vt, bias=bias, scale=scale,
-                                        chunk_size=kv_chunk_size)
+                                        chunk_size=kv_chunk_size,
+                                        dropout_rate=drop, rng=rng)
         elif impl == "flash":
             import perceiver_tpu.ops.pallas_attention as _pa
             out = _pa.flash_attention(qt, kt, vt, bias=bias, scale=scale,

@@ -36,7 +36,8 @@ def pad_mask_to_bias(key_padding_mask, dtype=jnp.float32):
     return jnp.where(key_padding_mask, NEG_INF, 0.0).astype(dtype)
 
 
-def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc):
+def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc,
+               dropout_rate: float = 0.0, dropout_key=None):
     """One online-softmax block fold — THE shared recurrence.
 
     Folds a key/value block into running statistics. Used by the kv
@@ -48,6 +49,13 @@ def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc):
 
     q: (B,H,Lq,D); k_blk, v_blk: (B,H,Lk,D); bias_blk: (B,Lk) or None;
     m, l: (B,H,Lq,1); acc: (B,H,Lq,D) — fp32 accumulators.
+
+    Attention-weight dropout (torch semantics: applied to the
+    normalized softmax weights) streams exactly: dropping weight w_k
+    after softmax equals dropping the exp value in the OUTPUT
+    accumulator while the denominator ``l`` keeps every exp value —
+    out = (1/l)·Σ_k mask_k/(1−rate)·exp_k·v_k. So ``acc`` folds the
+    dropped exp block and ``l`` the undropped one.
     """
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
                    preferred_element_type=jnp.float32) * scale
@@ -57,8 +65,14 @@ def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc):
     p = jnp.exp(s - m_new)
     alpha = jnp.exp(m - m_new)
     l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    if dropout_key is not None and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate,
+                                    p.shape)
+        p_acc = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    else:
+        p_acc = p
     acc_new = acc * alpha + jnp.einsum(
-        "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+        "bhqk,bhkd->bhqd", p_acc.astype(v_blk.dtype), v_blk,
         preferred_element_type=jnp.float32)
     return m_new, l_new, acc_new
 
@@ -71,7 +85,8 @@ def finalize_softmax(l, acc, dtype):
 def chunked_attention(q, k, v, *, bias: Optional[jax.Array] = None,
                       scale: Optional[float] = None,
                       chunk_size: int = 1024,
-                      q_chunk_size: Optional[int] = None):
+                      q_chunk_size: Optional[int] = None,
+                      dropout_rate: float = 0.0, rng=None):
     """Exact attention with kv streamed in chunks.
 
     q: (B, H, Lq, D); k, v: (B, H, Lk, D).
@@ -79,6 +94,10 @@ def chunked_attention(q, k, v, *, bias: Optional[jax.Array] = None,
     q_chunk_size: additionally block the query axis (lax.map over query
     slices) — needed when Lq is huge (the 262k-query decoder), where
     even one (B, H, Lq, chunk) logit block would blow HBM.
+    dropout_rate/rng: attention-weight dropout (torch placement, after
+    softmax — see ``fold_block`` for why it streams exactly); each kv
+    chunk's mask comes from ``fold_in(rng, chunk_index)``, each query
+    chunk from a further fold, so no (Lq, Lk) mask materializes.
     Returns (B, H, Lq, D) in q's dtype.
 
     The kv scan body is rematerialized (``jax.checkpoint``), so the
@@ -97,10 +116,16 @@ def chunked_attention(q, k, v, *, bias: Optional[jax.Array] = None,
         qp = jnp.pad(q, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
         nq = (lq + q_pad) // qc
         qs = qp.reshape(b, h, nq, qc, d).transpose(2, 0, 1, 3, 4)
-        out = jax.lax.map(
-            lambda qi: chunked_attention(qi, k, v, bias=bias, scale=scale,
-                                         chunk_size=chunk_size),
-            qs)
+
+        def one_q_chunk(args):
+            qi, idx = args
+            r = (jax.random.fold_in(rng, idx)
+                 if rng is not None and dropout_rate > 0.0 else None)
+            return chunked_attention(qi, k, v, bias=bias, scale=scale,
+                                     chunk_size=chunk_size,
+                                     dropout_rate=dropout_rate, rng=r)
+
+        out = jax.lax.map(one_q_chunk, (qs, jnp.arange(nq)))
         out = out.transpose(1, 2, 0, 3, 4).reshape(b, h, nq * qc, d)
         return out[:, :, :lq]
     lk = k.shape[2]
@@ -122,20 +147,23 @@ def chunked_attention(q, k, v, *, bias: Optional[jax.Array] = None,
     vc = v.reshape(b, h, n_chunks, chunk, d).transpose(2, 0, 1, 3, 4)
     if bias is not None:
         bc = bias.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
-        xs = (kc, vc, bc)
+        xs = (kc, vc, bc, jnp.arange(n_chunks))
     else:
-        xs = (kc, vc)
+        xs = (kc, vc, jnp.arange(n_chunks))
 
     m0 = jnp.full((b, h, lq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, lq, 1), jnp.float32)
     acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    dropping = rng is not None and dropout_rate > 0.0
 
     def body(carry, x):
         if bias is not None:
-            k_i, v_i, b_i = x
+            k_i, v_i, b_i, ci = x
         else:
-            (k_i, v_i), b_i = x, None
-        return fold_block(q, k_i, v_i, b_i, scale, *carry), None
+            (k_i, v_i, ci), b_i = x, None
+        dk = jax.random.fold_in(rng, ci) if dropping else None
+        return fold_block(q, k_i, v_i, b_i, scale, *carry,
+                          dropout_rate=dropout_rate, dropout_key=dk), None
 
     (m, l, acc), _ = jax.lax.scan(jax.checkpoint(body), (m0, l0, acc0), xs)
     return finalize_softmax(l, acc, q.dtype)

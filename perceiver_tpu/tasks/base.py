@@ -41,6 +41,18 @@ class TaskConfig:
     attention_impl: Optional[str] = None
     kv_chunk_size: int = 1024
 
+    def __post_init__(self):
+        # fail at config time, not deep inside a jit trace: attention-
+        # weight dropout is only implemented for the einsum and chunked
+        # kernels (chunked streams it — see ops/chunked_attention.py)
+        if self.dropout > 0.0 and self.attention_impl in (
+                "flash", "seqpar", "ring", "ulysses"):
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} does not "
+                f"support attention-weight dropout "
+                f"(dropout={self.dropout}); use attention_impl="
+                "'einsum' or 'chunked', or set --model.dropout=0")
+
     @property
     def latent_shape(self) -> Tuple[int, int]:
         return (self.num_latents, self.num_latent_channels)

@@ -81,6 +81,7 @@ class MaskedLanguageModelTask(TaskConfig):
     packed_capacity: Optional[float] = None
 
     def __post_init__(self):
+        super().__post_init__()
         if self.loss_impl not in ("dense", "fused", "packed", "pallas"):
             raise ValueError(
                 f"unknown loss_impl {self.loss_impl!r}; expected "

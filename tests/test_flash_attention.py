@@ -163,6 +163,78 @@ class TestMhaImpls:
                       deterministic=False, rng=jax.random.key(1),
                       impl="flash")
 
+    def test_dropout_plus_flash_rejected_at_config_time(self):
+        """--model.dropout>0 with a non-dropout-capable impl must fail
+        when the task config is built, not deep inside a trace."""
+        from perceiver_tpu.tasks.image import ImageClassifierTask
+        with pytest.raises(ValueError, match="dropout"):
+            ImageClassifierTask(image_shape=(28, 28, 1), num_classes=10,
+                                dropout=0.1, attention_impl="flash")
+        # dropout-capable impls still construct fine
+        ImageClassifierTask(image_shape=(28, 28, 1), num_classes=10,
+                            dropout=0.1, attention_impl="chunked")
+
+
+class TestChunkedDropout:
+    """Streamed attention dropout in the chunked impl: exact vs. the
+    materialized construction with the identical per-chunk masks."""
+
+    def _masked_reference(self, q, k, v, rng, rate, chunk):
+        """softmax → apply the SAME per-chunk bernoulli masks →  @ v."""
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+        w = jax.nn.softmax(s, axis=-1)
+        lk = k.shape[2]
+        keeps = []
+        for ci in range(lk // chunk):
+            dk = jax.random.fold_in(rng, ci)
+            keeps.append(jax.random.bernoulli(
+                dk, 1.0 - rate, (*w.shape[:3], chunk)))
+        keep = jnp.concatenate(keeps, axis=-1)
+        w = jnp.where(keep, w / (1.0 - rate), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
+
+    def test_dropout_matches_materialized_masking(self):
+        q, k, v = _qkv(jax.random.key(5), lk=96)
+        rng = jax.random.key(42)
+        out = chunked_attention(q, k, v, chunk_size=32,
+                                dropout_rate=0.3, rng=rng)
+        ref = self._masked_reference(q, k, v, rng, 0.3, 32)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_dropout_mean_preserved(self):
+        """E[dropped attention] == undropped attention (1/(1-p) scaling),
+        checked loosely over many independent masks."""
+        q, k, v = _qkv(jax.random.key(6), b=1, h=1, lq=4, lk=32, d=8)
+        base = chunked_attention(q, k, v, chunk_size=16)
+        one = jax.jit(lambda r: chunked_attention(
+            q, k, v, chunk_size=16, dropout_rate=0.2, rng=r))
+        outs = jax.vmap(one)(jax.random.split(jax.random.key(0), 200))
+        np.testing.assert_allclose(jnp.mean(outs, axis=0), base, atol=0.08)
+
+    def test_mha_chunked_dropout_accepted_and_differs(self):
+        params = mha_init(jax.random.key(0), q_dim=16, num_heads=2)
+        x = jax.random.normal(jax.random.key(1), (2, 8, 16))
+        out_det = mha_apply(params, x, x, x, num_heads=2, impl="chunked")
+        out_drop = mha_apply(params, x, x, x, num_heads=2, impl="chunked",
+                             dropout_rate=0.5, deterministic=False,
+                             rng=jax.random.key(2))
+        assert out_drop.shape == out_det.shape
+        assert not np.allclose(out_drop, out_det)
+
+    def test_dropout_gradients_flow(self):
+        q, k, v = _qkv(jax.random.key(7), lk=32)
+
+        def loss(q, k, v):
+            return chunked_attention(q, k, v, chunk_size=16,
+                                     dropout_rate=0.2,
+                                     rng=jax.random.key(3)).sum()
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for g in grads:
+            assert jnp.all(jnp.isfinite(g))
+            assert jnp.any(g != 0)
+
 
 class TestQueryChunking:
     def test_q_chunked_matches_reference(self):
