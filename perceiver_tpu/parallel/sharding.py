@@ -64,13 +64,21 @@ def param_spec(path, leaf) -> P:
 
 
 def param_sharding(params, mesh: Mesh):
-    """Pytree of NamedShardings matching ``params``."""
+    """Pytree of NamedShardings matching ``params``.
+
+    A dim whose size the mesh axis doesn't divide falls back to
+    replication for that dim (e.g. the (C, 10003) vocab projection on
+    an odd vocab over model=2 — GSPMD requires even splits)."""
     has_model = "model" in mesh.axis_names and \
         mesh.shape.get("model", 1) > 1
 
     def spec(path, leaf):
         s = param_spec(path, leaf) if has_model else P()
-        return NamedSharding(mesh, s)
+        fixed = tuple(
+            ax if ax is None or leaf.shape[d] % mesh.shape[ax] == 0
+            else None
+            for d, ax in enumerate(s))
+        return NamedSharding(mesh, P(*fixed))
 
     return jax.tree_util.tree_map_with_path(spec, params)
 

@@ -219,3 +219,25 @@ def test_mlm_seq_parallel_matches_replicated():
     for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(grads)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_param_sharding_indivisible_dim_falls_back_to_replication():
+    """A tensor-parallel spec on a dim the mesh axis doesn't divide
+    (e.g. the (C, 10003) vocab projection over model=2) must fall back
+    to replicating that dim instead of crashing device_put."""
+    from jax.sharding import PartitionSpec as P
+
+    from perceiver_tpu.parallel.sharding import param_sharding
+
+    mesh = make_mesh(8, model_parallel=2)
+    params = {
+        "linear": {"w": jnp.zeros((64, 10003)),   # odd vocab: replicate
+                   "b": jnp.zeros((10003,))},
+        "fc1": {"w": jnp.zeros((64, 128)),        # divisible: sharded
+                "b": jnp.zeros((128,))},
+    }
+    shardings = param_sharding(params, mesh)
+    assert shardings["linear"]["w"].spec == P(None, None)
+    assert shardings["fc1"]["w"].spec == P(None, "model")
+    assert shardings["fc1"]["b"].spec == P("model")
+    jax.device_put(params, shardings)  # must not raise
