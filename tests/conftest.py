@@ -24,3 +24,68 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# --- slow-test marking (VERDICT r1 weak #6) ---------------------------------
+# Central list instead of scattered decorators so the fast-gate budget
+# (`pytest -m "not slow"` < 8 min single-core) is tunable in one place.
+# Names are `file.py::test_name` with parametrization brackets when a
+# single variant is slow. Everything here still runs in the full suite.
+
+_SLOW = {
+    "test_models.py::test_remat_is_numerically_transparent",
+    "test_models.py::test_attention_impl_parity_through_model",
+    "test_models.py::test_dropout_only_active_in_training",
+    "test_models.py::test_perceiver_io_image_classifier_shapes",
+    "test_large_configs.py::test_mlm_seq_parallel_matches_replicated",
+    "test_large_configs.py::test_text_classifier_dp8_step",
+    "test_large_configs.py::test_mlm_train_step_on_dp_tp_mesh[2]",
+    "test_large_configs.py::test_mlm_train_step_on_dp_tp_mesh[4]",
+    "test_training.py::test_trainer_dp_tp_sp_mesh",
+    "test_training.py::test_overfit_batches_loss_decreases",
+    "test_training.py::test_preemption_checkpoint_and_resume",
+    "test_training.py::test_checkpoint_save_restore_resume",
+    "test_training.py::test_mlm_task_end_to_end",
+    "test_training.py::test_tb_event_files_written",
+    "test_training.py::test_trainer_on_virtual_mesh",
+    "test_training.py::test_terminate_on_nan_raises[1]",
+    "test_training.py::test_terminate_on_nan_raises[50]",
+    "test_training.py::test_text_classifier_transfer_and_freeze",
+    "test_steps_per_execution.py::test_matches_single_step",
+    "test_steps_per_execution.py::test_trailing_partial_group",
+    "test_steps_per_execution.py::test_max_steps_not_overshot",
+    "test_steps_per_execution.py::test_on_virtual_mesh",
+    "test_steps_per_execution.py::test_resume_at_max_steps_trains_zero_steps",
+    "test_segmentation.py::test_run_script_uresnet_end_to_end",
+    "test_segmentation.py::test_uresnet_task_loss_and_state",
+    "test_segmentation.py::test_run_script_end_to_end",
+    "test_segmentation.py::test_run_script_val_events_zero",
+    "test_ring_attention.py::TestRingAttention::test_grad_flows",
+    "test_uresnet.py::test_uresnet_gradients_flow",
+    "test_ulysses.py::TestUlyssesAttention::test_grad_flows",
+    "test_spmd_attention_impls.py::test_full_train_step_under_jit",
+    "test_spmd_attention_impls.py::test_matches_einsum_baseline[seqpar-4]",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import warnings
+
+    import pytest as _pytest
+
+    matched = set()
+    for item in items:
+        key = f"{item.path.name}::{item.name}"
+        clskey = (f"{item.path.name}::{item.cls.__name__}::{item.name}"
+                  if item.cls else None)
+        hit = key if key in _SLOW else (clskey if clskey in _SLOW else None)
+        if hit:
+            matched.add(hit)
+            item.add_marker(_pytest.mark.slow)
+    # self-verifying list: a renamed/moved test must not silently
+    # rejoin the fast gate (only meaningful on full-directory runs —
+    # single-file invocations legitimately miss other files' entries)
+    leftovers = _SLOW - matched
+    if leftovers and len({i.path for i in items}) > 10:
+        warnings.warn(f"stale _SLOW entries (no matching test): "
+                      f"{sorted(leftovers)}")
