@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -64,5 +65,10 @@ class ImageInputAdapter:
                 f"required shape {tuple(self.image_shape)}")
         x = x.reshape(b, -1, self.num_image_channels)
         enc = jnp.asarray(self.position_encoding(), policy.compute_dtype)
+        # opaque to the simplifier: without the barrier, XLA reassociates
+        # the downstream LayerNorm reduce across the concat and then
+        # constant-folds the encoding-only reduce with its naive (and
+        # very slow — ~20 s per compile at MNIST shapes) host evaluator
+        enc = jax.lax.optimization_barrier(enc)
         enc = jnp.broadcast_to(enc[None], (b, *enc.shape))
         return jnp.concatenate([policy.cast_compute(x), enc], axis=-1)
