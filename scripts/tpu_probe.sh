@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the axon TPU backend every ~4 min with a hard timeout; append
+# a timestamped status line per attempt. Exits when the backend is up.
+LOG=${1:-/root/repo/logs/tpu_probe.log}
+mkdir -p "$(dirname "$LOG")"
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 120 python -c "import jax; d=jax.devices(); print('OK', len(d), d[0].platform)" 2>&1 | tail -1)
+  echo "$ts $out" >> "$LOG"
+  if [[ "$out" == OK* ]]; then
+    echo "$ts TPU BACKEND UP" >> "$LOG"
+    exit 0
+  fi
+  sleep 240
+done
