@@ -18,6 +18,16 @@ Grid layout: ``(B, H, num_q_blocks, num_kv_blocks)`` — the kv axis is
 innermost because TPU grids execute sequentially, which is what makes
 carrying (m, l, acc) across kv steps in scratch legal.
 
+Two block layouts, selected by head dim:
+
+- standard (``D > 32``): blocks are (L, D) with D padded to 128 lanes.
+- transposed (``D <= 32``): blocks are (D, L) — every 64-channel/
+  4-head BASELINE config has head dim 16, which the standard layout
+  would pad 8x in the lane axis; putting the huge kv axis on lanes and
+  the skinny head dim on sublanes (padded only to 16) cuts kv HBM
+  traffic ~8x. The (B,H,L,D) -> (B,H,D,L) relayout happens outside the
+  kernel, where XLA fuses it into the producing projection matmuls.
+
 Masking is an additive fp32 key bias ``(B, Lk)`` (``NEG_INF`` at
 padding), matching the einsum path's ``key_padding_mask`` semantics.
 Attention-weight dropout is not supported here (the reference default
@@ -97,7 +107,10 @@ def _flash_forward(q, k, v, bias, scale: float,
     # outputs unchanged; padded kv columns are killed by NEG_INF bias;
     # padded query rows are sliced off after.
     dp = _round_up(d, 128)
-    block_q = min(block_q, _round_up(lq, 8))
+    # 16-sublane rounding covers the strictest dtype tile (bf16 needs
+    # sublane multiples of 16; fp32 needs 8 — 16 satisfies both), e.g.
+    # the 1-query classification decoder under impl="flash"
+    block_q = min(block_q, _round_up(lq, 16))
     block_k = _round_up(min(block_k, _round_up(lk, 128)), 128)
     lq_p = _round_up(lq, block_q)
     lk_p = _round_up(lk, block_k)
@@ -139,13 +152,136 @@ def _flash_forward(q, k, v, bias, scale: float,
     return out[:, :, :lq, :d]
 
 
+def _flash_kernel_t(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                    m_ref, l_ref, acc_ref, *, scale: float, nk: int):
+    """Transposed-layout kernel: q/k/v/o are (..., D, L) so the HUGE
+    kv axis is the 128-lane minor dim and the skinny head dim (16 for
+    every 64-channel/4-head BASELINE config) rides the sublane axis
+    unpadded — 8x less HBM traffic than padding D up to 128 lanes."""
+    ib = pl.program_id(0)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    qt = q_ref[0, 0]  # (Dp, block_q)
+    kt = k_ref[0, 0]  # (Dp, block_k)
+    vt = v_ref[0, 0]  # (Dp, block_k)
+
+    s = jax.lax.dot_general(
+        qt, kt, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # (block_q, block_k)
+    s = s + bias_ref[pl.ds(ib, 1), :]
+
+    m_prev = m_ref[:, :1]                                # (block_q, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+    # acc wants q on the LANE axis; softmax stats have q on SUBLANE.
+    # Cross the orientations with one tile-aligned (block_q, 128) →
+    # (128, block_q) transpose per kv step (a standard Mosaic relayout;
+    # both dims are tile multiples, unlike a (block_q, 1) vector).
+    alpha_t = jax.lax.transpose(
+        jnp.broadcast_to(alpha, (alpha.shape[0], 128)), (1, 0))
+    dp = acc_ref.shape[0]
+    acc_ref[:] = acc_ref[:] * alpha_t[:dp] + jax.lax.dot_general(
+        vt, p.astype(vt.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (Dp, block_q)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l_t = jax.lax.transpose(l_ref[:], (1, 0))        # (128, block_q)
+        o_ref[0, 0] = (acc_ref[:] /
+                       jnp.maximum(l_t[:dp], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward_t(q, k, v, bias, scale: float,
+                     block_q: int, block_k: int, interpret: bool):
+    """Forward via the transposed kernel. Takes standard (B, H, L, D)
+    arrays; the (D, L) relayout happens outside the kernel where XLA
+    fuses it into the producing projection matmuls."""
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+
+    # sublane-pad D to the strictest tile (16 covers bf16 and fp32);
+    # lane-pad both L axes to their block sizes. Both L blocks are the
+    # MINOR dim of their arrays here, so Mosaic requires them to be
+    # 128-multiples — round the user's block_q UP (the standard layout
+    # only needs sublane-rounding for it).
+    dp = _round_up(d, 16)
+    block_q = _round_up(min(block_q, _round_up(lq, 128)), 128)
+    block_k = _round_up(min(block_k, _round_up(lk, 128)), 128)
+    lq_p = _round_up(lq, block_q)
+    lk_p = _round_up(lk, block_k)
+
+    qt = jnp.pad(q.swapaxes(2, 3), ((0, 0), (0, 0), (0, dp - d),
+                                    (0, lq_p - lq)))
+    kt = jnp.pad(k.swapaxes(2, 3), ((0, 0), (0, 0), (0, dp - d),
+                                    (0, lk_p - lk)))
+    vt = jnp.pad(v.swapaxes(2, 3), ((0, 0), (0, 0), (0, dp - d),
+                                    (0, lk_p - lk)))
+    if bias is None:
+        bias = jnp.zeros((b, lk), jnp.float32)
+    bias = jnp.pad(bias.astype(jnp.float32), ((0, 0), (0, lk_p - lk)),
+                   constant_values=NEG_INF)
+
+    nq, nk = lq_p // block_q, lk_p // block_k
+    grid = (b, h, nq, nk)
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel_t, scale=scale, nk=nk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, dp, block_q),
+                         lambda ib, ih, iq, ik: (ib, ih, 0, iq)),
+            pl.BlockSpec((1, 1, dp, block_k),
+                         lambda ib, ih, iq, ik: (ib, ih, 0, ik)),
+            pl.BlockSpec((1, 1, dp, block_k),
+                         lambda ib, ih, iq, ik: (ib, ih, 0, ik)),
+            pl.BlockSpec((b, block_k),
+                         lambda ib, ih, iq, ik: (0, ik)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, dp, block_q),
+                               lambda ib, ih, iq, ik: (ib, ih, 0, iq)),
+        out_shape=jax.ShapeDtypeStruct((b, h, dp, lq_p), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),   # normalizer l
+            pltpu.VMEM((dp, block_q), jnp.float32),    # acc, q on lanes
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, bias)
+    return out[:, :, :d, :lq].swapaxes(2, 3)
+
+
+# D at or below this uses the transposed kernel: the padding ratio
+# 128/D makes the standard layout waste >=4x HBM bandwidth on kv
+_SKINNY_D = 32
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, bias, scale, block_q, block_k, interpret):
+    return _flash_forward_any(q, k, v, bias, scale, block_q, block_k,
+                              interpret)
+
+
+def _flash_forward_any(q, k, v, bias, scale, block_q, block_k, interpret):
+    if q.shape[-1] <= _SKINNY_D:
+        return _flash_forward_t(q, k, v, bias, scale, block_q, block_k,
+                                interpret)
     return _flash_forward(q, k, v, bias, scale, block_q, block_k, interpret)
 
 
 def _flash_fwd(q, k, v, bias, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, bias, scale, block_q, block_k, interpret)
+    out = _flash_forward_any(q, k, v, bias, scale, block_q, block_k,
+                             interpret)
     return out, (q, k, v, bias)
 
 
