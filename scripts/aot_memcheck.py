@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""AOT memory check for the two big BASELINE configs (VERDICT r1 #6).
+
+Compiles (compile ONLY — no execution) the full train step of:
+
+1. the 224×224 / 512-latent classifier preset (BASELINE configs[3],
+   v5e-8 target) at its per-chip batch shard, and
+2. the v5p-16 Perceiver-LM MLM preset (1024×512 latents, 12 self-attn
+   layers/block, seq 2048; BASELINE configs[4]) at its per-chip shard,
+
+on whatever single device is available, and reports XLA's HBM usage
+estimates (argument/output/temp/generated-code sizes). This validates
+that remat + query chunking keep the per-chip footprint inside a
+v5e/v5p chip's HBM before any pod time is spent.
+
+Usage: python scripts/aot_memcheck.py [224 | lm | all]
+Env:   MEMCHECK_PLATFORM=cpu   (forces the CPU backend for smoke runs)
+"""
+
+import json
+import os
+import sys
+from functools import partial
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _mem_analysis(compiled):
+    try:
+        m = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"memory_analysis unavailable: {e}"}
+    keys = (
+        "argument_size_in_bytes", "output_size_in_bytes",
+        "temp_size_in_bytes", "generated_code_size_in_bytes",
+        "alias_size_in_bytes",
+    )
+    out = {}
+    for k in keys:
+        v = getattr(m, k, None)
+        if v is not None:
+            out[k.replace("_in_bytes", "_mb")] = round(v / 2**20, 1)
+    # peak live ≈ args + temps (outputs alias donated args here)
+    if "argument_size_mb" in out and "temp_size_mb" in out:
+        out["approx_peak_mb"] = round(
+            out["argument_size_mb"] + out["temp_size_mb"], 1)
+    return out
+
+
+def _compile_train_step(task, batch, label):
+    import jax
+    import optax
+
+    from perceiver_tpu.ops.policy import Policy
+
+    model = task.build()
+    policy = Policy.bf16()
+    params = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    tx = optax.adamw(1e-3)
+    opt_state = jax.eval_shape(tx.init, params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch, rng):
+        def loss_fn(p):
+            loss, _ = task.loss_and_metrics(model, p, batch, rng=rng,
+                                            deterministic=False,
+                                            policy=policy)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    shapes = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+              for k, v in batch.items()}
+    print(f"[{label}] lowering ...", file=sys.stderr, flush=True)
+    lowered = train_step.lower(
+        params, opt_state, shapes,
+        jax.ShapeDtypeStruct((), jax.random.key(0).dtype))
+    print(f"[{label}] compiling ...", file=sys.stderr, flush=True)
+    compiled = lowered.compile()
+    return _mem_analysis(compiled)
+
+
+def check_224(per_chip_batch: int = 4):
+    """224×224/512-latent classifier; v5e-8 runs dp8, so the per-chip
+    shard is global_batch/8 (preset batch 32 → 4/chip)."""
+    import jax.numpy as jnp
+
+    from perceiver_tpu.tasks import ImageClassifierTask
+
+    task = ImageClassifierTask(
+        image_shape=(224, 224, 3), num_classes=1000,
+        num_frequency_bands=64, num_latents=512, num_latent_channels=512,
+        num_encoder_layers=6,
+        num_encoder_self_attention_layers_per_block=6,
+        num_encoder_cross_attention_heads=8,
+        num_encoder_self_attention_heads=8,
+        num_decoder_cross_attention_heads=8,
+        remat=True, attention_impl="chunked", kv_chunk_size=4096)
+    batch = {
+        "image": jnp.zeros((per_chip_batch, 224, 224, 3), jnp.float32),
+        "label": jnp.zeros((per_chip_batch,), jnp.int32),
+    }
+    return _compile_train_step(task, batch, "224")
+
+
+def check_lm(per_chip_batch: int = 2):
+    """v5p-16 Perceiver-LM preset per-chip shard: the mesh is dp4×tp4
+    (scripts/configs/perceiver_lm_v5p16.yaml); tensor-parallel weight
+    shards aren't modeled single-chip, so this is the CONSERVATIVE
+    (replicated-weights) bound."""
+    import jax.numpy as jnp
+
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    task = MaskedLanguageModelTask(
+        vocab_size=32000, max_seq_len=2048,
+        num_latents=1024, num_latent_channels=512,
+        num_encoder_layers=2,
+        num_encoder_self_attention_layers_per_block=12,
+        num_encoder_cross_attention_heads=8,
+        num_encoder_self_attention_heads=8,
+        num_decoder_cross_attention_heads=8,
+        remat=True, loss_impl="packed")
+    batch = {
+        "input_ids": jnp.zeros((per_chip_batch, 2048), jnp.int32),
+        "pad_mask": jnp.zeros((per_chip_batch, 2048), bool),
+    }
+    return _compile_train_step(task, batch, "lm")
+
+
+def main():
+    import jax
+
+    want = os.environ.get("MEMCHECK_PLATFORM")
+    if want:
+        jax.config.update("jax_platforms", want)
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+
+    out = {"device": str(jax.devices()[0])}
+    if which in ("224", "all"):
+        out["classifier_224"] = check_224()
+    if which in ("lm", "all"):
+        out["perceiver_lm_v5p16_shard"] = check_lm()
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

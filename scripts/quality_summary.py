@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Summarize quality-parity runs from their TensorBoard event files.
+
+Reads ``logs/<experiment>/version_*/events.*`` (written by the
+framework's own dependency-free event writer, ``utils/tb.py``) with the
+installed ``tensorboard`` reader — a cross-implementation check in
+itself — and prints first/best/final values per scalar.
+
+Usage: python scripts/quality_summary.py [experiment ...]
+"""
+
+import glob
+import json
+import os
+import sys
+
+from tensorboard.backend.event_processing.event_accumulator import (
+    EventAccumulator,
+)
+
+
+def summarize(exp_dir: str) -> dict:
+    versions = sorted(glob.glob(os.path.join(exp_dir, "version_*")))
+    if not versions:
+        return {"error": f"no versions under {exp_dir}"}
+    acc = EventAccumulator(versions[-1],
+                          size_guidance={"scalars": 100000})
+    acc.Reload()
+    out = {"version": os.path.basename(versions[-1])}
+    for tag in sorted(acc.Tags().get("scalars", [])):
+        events = acc.Scalars(tag)
+        if not events:
+            continue
+        values = [e.value for e in events]
+        best = min(values) if "loss" in tag else max(values)
+        out[tag] = {
+            "first": round(values[0], 4),
+            "best": round(best, 4),
+            "final": round(values[-1], 4),
+            "n": len(values),
+            "final_step": events[-1].step,
+        }
+    return out
+
+
+def main():
+    exps = sys.argv[1:] or sorted(
+        os.path.basename(d) for d in glob.glob("logs/quality_*")
+        if os.path.isdir(d))
+    print(json.dumps({e: summarize(os.path.join("logs", e))
+                      for e in exps}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,9 @@ while true; do
   ts=$(date -u +%FT%TZ)
   out=$(timeout 120 python -c "import jax; d=jax.devices(); print('OK', len(d), d[0].platform)" 2>&1 | tail -1)
   echo "$ts $out" >> "$LOG"
-  if [[ "$out" == OK* ]]; then
+  # require the axon/tpu platform explicitly: jax can fall back to the
+  # CPU backend and still print OK when the tunnel is down
+  if [[ "$out" == OK* && ( "$out" == *axon* || "$out" == *tpu* ) ]]; then
     echo "$ts TPU BACKEND UP" >> "$LOG"
     exit 0
   fi

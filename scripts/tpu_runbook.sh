@@ -1,0 +1,47 @@
+#!/bin/bash
+# On-chip evidence runbook — run the moment the axon backend is up.
+# Collects, in priority order, everything VERDICT r1 asked for from
+# real hardware; each stage appends to logs/tpu_runbook/ so a tunnel
+# drop mid-run still leaves the earlier evidence on disk.
+#
+# Usage: scripts/tpu_runbook.sh [stage ...]   (default: all stages)
+# Stages: bench img kernels memcheck seg sweep
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=logs/tpu_runbook
+mkdir -p "$OUT"
+STAGES=${@:-bench img kernels memcheck seg sweep}
+ts() { date -u +%FT%TZ; }
+
+run_stage() {
+  local name=$1; shift
+  echo "=== [$(ts)] stage $name: $*" | tee -a "$OUT/runbook.log"
+  ( "$@" ) > "$OUT/$name.out" 2> "$OUT/$name.err"
+  local rc=$?
+  echo "=== [$(ts)] stage $name rc=$rc" | tee -a "$OUT/runbook.log"
+  tail -3 "$OUT/$name.out" | tee -a "$OUT/runbook.log"
+  return $rc
+}
+
+for s in $STAGES; do
+  case $s in
+    bench)   # primary metric: MLM tokens/sec/chip + MFU (ladder)
+      run_stage bench timeout 3000 python bench.py ;;
+    img)     # secondary metric: MNIST imgs/sec/chip
+      run_stage img env BENCH_TASK=img_clf timeout 1800 python bench.py ;;
+    kernels) # flash/chunked/einsum on-chip microbench (VERDICT #2)
+      run_stage kernels env KERNEL_SHAPES=mnist,mlm,seg,lm2048 \
+        timeout 3000 python scripts/bench_kernels.py ;;
+    memcheck) # AOT HBM estimates for the two big configs (VERDICT #6)
+      run_stage memcheck timeout 1800 python scripts/aot_memcheck.py all ;;
+    seg)     # one real 512x512 / 262k-query train step (VERDICT #7)
+      run_stage seg timeout 1800 python run.py --size 512 \
+        --num-synthetic 8 --batch-size 2 --epochs 1 --val-events 0 \
+        --logdir "$OUT/seg_logs" --ckpt-dir "$OUT/seg_ckpt" ;;
+    sweep)   # batch/inner/loss_impl tuning sweep (longest; last)
+      run_stage sweep timeout 6000 python scripts/bench_sweep.py ;;
+    *) echo "unknown stage $s" ;;
+  esac
+done
+echo "=== [$(ts)] runbook complete" | tee -a "$OUT/runbook.log"
