@@ -273,15 +273,21 @@ def pallas_linear_cross_entropy(linear_params, hidden, labels, weight, *,
     zero gradient (they are masks/targets, not trained).
     """
     from perceiver_tpu.ops.policy import DEFAULT_POLICY
+    from perceiver_tpu.utils.platform import is_tpu_platform
     policy = policy or DEFAULT_POLICY
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # plugin TPU backends report their own platform name ("axon"),
+        # not "tpu" — a name check against "tpu" alone would silently
+        # run the kernel in interpreter mode on the real chip
+        interpret = not is_tpu_platform(jax.default_backend())
 
     n = hidden.shape[0]
     h = policy.cast_compute(hidden)
     w = policy.cast_param(linear_params["w"])
     b = policy.cast_param(linear_params["b"])
-    block_n = min(block_n, _round_up(n, 8))
+    # 16-sublane rounding covers the strictest dtype tile (bf16 needs
+    # 16; fp32 needs 8) for tiny packed-capacity row counts
+    block_n = min(block_n, _round_up(n, 16))
     block_v = min(block_v, _round_up(w.shape[1], 128))
 
     nll, _ = _nll_and_lse(h, w, b, labels, int(block_n), int(block_v),

@@ -129,8 +129,9 @@ def apply_accelerator(accelerator: str) -> None:
     # verify the selection actually took rather than trusting the call.
     # "tpu" keeps the environment default but still verifies a TPU-class
     # platform actually came up ("axon" is this container's TPU plugin).
+    from perceiver_tpu.utils.platform import is_tpu_platform
     got = jax.devices()[0].platform
-    ok = got in ("tpu", "axon") if acc == "tpu" else got == acc
+    ok = is_tpu_platform(got) if acc == "tpu" else got == acc
     if not ok:
         raise RuntimeError(
             f"--trainer.accelerator={acc} had no effect (running on "

@@ -36,8 +36,9 @@ _PEAK_BF16 = {
 def device_peak_flops(device: Optional[jax.Device] = None,
                       precision: str = "bf16") -> Optional[float]:
     """Peak FLOP/s for one chip, or None when unknown (CPU/GPU)."""
+    from perceiver_tpu.utils.platform import is_tpu_platform
     device = device or jax.devices()[0]
-    if device.platform not in ("tpu", "axon"):
+    if not is_tpu_platform(device.platform):
         return None
     kind = device.device_kind.lower().replace(" ", "").replace("-", "")
     for tag, peak in _PEAK_BF16.items():

@@ -6,10 +6,23 @@
 #
 # Usage: scripts/tpu_runbook.sh [stage ...]   (default: all stages)
 # Stages: bench img kernels memcheck seg sweep
+# RUNBOOK_SMOKE=1 runs every stage on the CPU backend at tiny settings
+# — validates stage wiring without a chip (and without chip-scale cost).
 
 set -u
 cd "$(dirname "$0")/.."
 OUT=logs/tpu_runbook
+SMOKE_ENV=()
+SEG_SIZE=512; SWEEP_ARGS=""; SEG_ACCEL=()
+KSHAPES=mnist,mlm,seg,lm2048
+if [[ "${RUNBOOK_SMOKE:-}" == 1 ]]; then
+  OUT=logs/tpu_runbook_smoke
+  SMOKE_ENV=(BENCH_PLATFORM=cpu MEMCHECK_PLATFORM=cpu
+             BENCH_BATCH=8 BENCH_INNER_STEPS=1 KERNEL_REPS=2
+             SWEEP_IMPLS=packed SWEEP_INNER=1)
+  KSHAPES=mnist
+  SEG_SIZE=64; SWEEP_ARGS="8"; SEG_ACCEL=(--accelerator cpu)
+fi
 mkdir -p "$OUT"
 STAGES=${@:-bench img kernels memcheck seg sweep}
 ts() { date -u +%FT%TZ; }
@@ -17,7 +30,7 @@ ts() { date -u +%FT%TZ; }
 run_stage() {
   local name=$1; shift
   echo "=== [$(ts)] stage $name: $*" | tee -a "$OUT/runbook.log"
-  ( "$@" ) > "$OUT/$name.out" 2> "$OUT/$name.err"
+  ( env "${SMOKE_ENV[@]}" "$@" ) > "$OUT/$name.out" 2> "$OUT/$name.err"
   local rc=$?
   echo "=== [$(ts)] stage $name rc=$rc" | tee -a "$OUT/runbook.log"
   tail -3 "$OUT/$name.out" | tee -a "$OUT/runbook.log"
@@ -31,16 +44,18 @@ for s in $STAGES; do
     img)     # secondary metric: MNIST imgs/sec/chip
       run_stage img env BENCH_TASK=img_clf timeout 1800 python bench.py ;;
     kernels) # flash/chunked/einsum on-chip microbench (VERDICT #2)
-      run_stage kernels env KERNEL_SHAPES=mnist,mlm,seg,lm2048 \
+      run_stage kernels env KERNEL_SHAPES="$KSHAPES" \
         timeout 3000 python scripts/bench_kernels.py ;;
     memcheck) # AOT HBM estimates for the two big configs (VERDICT #6)
       run_stage memcheck timeout 1800 python scripts/aot_memcheck.py all ;;
     seg)     # one real 512x512 / 262k-query train step (VERDICT #7)
-      run_stage seg timeout 1800 python run.py --size 512 \
+      run_stage seg timeout 1800 python run.py --size "$SEG_SIZE" \
         --num-synthetic 8 --batch-size 2 --epochs 1 --val-events 0 \
+        "${SEG_ACCEL[@]}" \
         --logdir "$OUT/seg_logs" --ckpt-dir "$OUT/seg_ckpt" ;;
     sweep)   # batch/inner/loss_impl tuning sweep (longest; last)
-      run_stage sweep timeout 6000 python scripts/bench_sweep.py ;;
+      run_stage sweep timeout 6000 python scripts/bench_sweep.py \
+        $SWEEP_ARGS ;;
     *) echo "unknown stage $s" ;;
   esac
 done
