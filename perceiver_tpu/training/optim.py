@@ -21,6 +21,40 @@ def _cls_name(class_path: str) -> str:
     return class_path.rsplit(".", 1)[-1]
 
 
+# hyperparameters each class actually reads — anything else in
+# init_args would be read by nobody and silently fall back to defaults
+_KNOWN_INIT_ARGS = {
+    "AdamW": {"lr", "learning_rate", "betas", "eps", "weight_decay"},
+    "Adam": {"lr", "learning_rate", "betas", "eps"},
+    "SGD": {"lr", "learning_rate", "momentum", "nesterov"},
+    "OneCycleLR": {"total_steps", "max_lr", "pct_start", "div_factor",
+                   "final_div_factor"},
+    "CosineAnnealingLR": {"T_max", "eta_min"},
+    "cosine": {"T_max", "eta_min"},
+    "StepLR": {"step_size", "gamma"},
+}
+
+
+def _check_keys(init: dict, group: str, name: str):
+    """Reject config keys nobody reads: ``--optimizer.lr=...`` (outside
+    init_args) or a typo'd ``--optimizer.init_args.weight_decy=...``
+    would otherwise be silently dropped and the run would train at the
+    defaults with no sign anything was ignored."""
+    unknown = set(init) - {"class_path", "init_args"}
+    if unknown:
+        raise ValueError(
+            f"unknown {group} config keys {sorted(unknown)}; hyper-"
+            f"parameters go under --{group}.init_args.* "
+            f"(e.g. --{group}.init_args.lr=0.002)")
+    known = _KNOWN_INIT_ARGS.get(name)
+    if known is not None:
+        stray = set(init.get("init_args", {})) - known
+        if stray:
+            raise ValueError(
+                f"{group} {name} does not support init_args "
+                f"{sorted(stray)}; supported: {sorted(known)}")
+
+
 def build_schedule(scheduler_init: Optional[dict],
                    base_lr: float,
                    max_steps: Optional[int] = None):
@@ -33,6 +67,7 @@ def build_schedule(scheduler_init: Optional[dict],
     if scheduler_init is None:
         return base_lr
     name = _cls_name(scheduler_init.get("class_path", ""))
+    _check_keys(scheduler_init, "lr_scheduler", name)
     args = dict(scheduler_init.get("init_args", {}))
     if name == "OneCycleLR":
         total = args.get("total_steps") or max_steps
@@ -75,6 +110,7 @@ def create_optimizer(
     optimizer_init = optimizer_init or {
         "class_path": "AdamW", "init_args": {"lr": 1e-3}}
     name = _cls_name(optimizer_init.get("class_path", "AdamW"))
+    _check_keys(optimizer_init, "optimizer", name)
     args = dict(optimizer_init.get("init_args", {}))
     lr = args.get("lr", args.get("learning_rate", 1e-3))
     schedule = build_schedule(scheduler_init, lr, max_steps)

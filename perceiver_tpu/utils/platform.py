@@ -5,8 +5,9 @@ report ``tpu``, while plugin backends surface their own name (this
 container's tunnel plugin reports ``axon``). Rather than sprinkling
 hard-coded quirk lists through the codebase (VERDICT r1 weak #5), the
 alias set lives here once and is extensible without a code change via
-``PERCEIVER_TPU_PLATFORM_ALIASES`` (comma-separated platform names to
-treat as TPU-class, default ``axon``).
+``PERCEIVER_TPU_PLATFORM_ALIASES`` (comma-separated EXTRA platform
+names to treat as TPU-class, added on top of the built-in
+``tpu``/``axon``).
 """
 
 from __future__ import annotations
@@ -15,9 +16,12 @@ import os
 
 
 def tpu_platform_names() -> tuple:
-    aliases = os.environ.get("PERCEIVER_TPU_PLATFORM_ALIASES", "axon")
-    return ("tpu",) + tuple(
-        a.strip() for a in aliases.split(",") if a.strip())
+    # additive, never replacing: dropping "axon" via an override would
+    # silently re-enable Pallas interpreter mode on this container's
+    # real chip — the exact failure this module exists to prevent
+    extra = os.environ.get("PERCEIVER_TPU_PLATFORM_ALIASES", "")
+    return ("tpu", "axon") + tuple(
+        a.strip() for a in extra.split(",") if a.strip())
 
 
 def is_tpu_platform(name: str) -> bool:

@@ -63,3 +63,33 @@ def test_freeze_labels_zero_frozen_updates():
     up, _ = tx.update(g, state, params)
     np.testing.assert_allclose(np.asarray(up["w"]), 0.0)
     assert float(jnp.abs(up["b"]).sum()) > 0
+
+
+def test_stray_top_level_hparam_keys_rejected():
+    """--optimizer.lr=x (outside init_args) must error, not silently
+    train at the default LR."""
+    import pytest
+
+    with pytest.raises(ValueError, match="init_args"):
+        create_optimizer({"class_path": "AdamW", "lr": 0.1})
+    with pytest.raises(ValueError, match="init_args"):
+        create_optimizer(
+            SGD, scheduler_init={"class_path": "OneCycleLR",
+                                 "max_lr": 0.1},
+            max_steps=10)
+
+
+def test_typod_init_args_keys_rejected():
+    """Typos INSIDE init_args (weight_decy, total_step) must error too
+    — every hparam is read with .get(default), so nothing else would
+    notice."""
+    import pytest
+
+    with pytest.raises(ValueError, match="weight_decy"):
+        create_optimizer({"class_path": "AdamW",
+                          "init_args": {"lr": 0.1, "weight_decy": 0.0}})
+    with pytest.raises(ValueError, match="total_step"):
+        create_optimizer(
+            SGD, scheduler_init={"class_path": "OneCycleLR",
+                                 "init_args": {"total_step": 5000}},
+            max_steps=10)
