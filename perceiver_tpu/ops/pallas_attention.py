@@ -187,19 +187,23 @@ def _flash_kernel_t(q_ref, k_ref, v_ref, bias_ref, o_ref,
     # acc wants q on the LANE axis; softmax stats have q on SUBLANE.
     # Cross the orientations with one tile-aligned (block_q, 128) →
     # (128, block_q) transpose per kv step (a standard Mosaic relayout;
-    # both dims are tile multiples, unlike a (block_q, 1) vector).
+    # both dims are tile multiples, unlike a (block_q, 1) vector); its
+    # rows are all identical, so row 0 broadcasts to any Dp.
     alpha_t = jax.lax.transpose(
         jnp.broadcast_to(alpha, (alpha.shape[0], 128)), (1, 0))
-    dp = acc_ref.shape[0]
-    acc_ref[:] = acc_ref[:] * alpha_t[:dp] + jax.lax.dot_general(
-        vt, p.astype(vt.dtype), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)              # (Dp, block_q)
+    acc_ref[:] = (acc_ref[:]
+                  * jnp.broadcast_to(alpha_t[:1], acc_ref.shape)
+                  + jax.lax.dot_general(
+                      vt, p.astype(vt.dtype), (((1,), (1,)), ((), ())),
+                      preferred_element_type=jnp.float32))  # (Dp, block_q)
 
     @pl.when(ik == nk - 1)
     def _():
         l_t = jax.lax.transpose(l_ref[:], (1, 0))        # (128, block_q)
         o_ref[0, 0] = (acc_ref[:] /
-                       jnp.maximum(l_t[:dp], 1e-30)).astype(o_ref.dtype)
+                       jnp.maximum(jnp.broadcast_to(l_t[:1],
+                                                    acc_ref.shape),
+                                   1e-30)).astype(o_ref.dtype)
 
 
 def _flash_forward_t(q, k, v, bias, scale: float,
@@ -266,6 +270,22 @@ def _flash_forward_t(q, k, v, bias, scale: float,
 _SKINNY_D = 32
 
 
+def _pick_layout(d: int) -> str:
+    """'transposed' or 'standard'; PERCEIVER_TPU_FLASH_LAYOUT overrides
+    the D-based auto choice (for on-chip A/B benchmarking)."""
+    import os
+    env = os.environ.get("PERCEIVER_TPU_FLASH_LAYOUT", "auto")
+    if env in ("standard", "transposed"):
+        return env
+    if env != "auto":
+        # a typo'd override would silently measure the auto layout in
+        # both arms of a chip-time A/B — reject like any other config
+        raise ValueError(
+            f"PERCEIVER_TPU_FLASH_LAYOUT={env!r}; expected 'auto', "
+            "'standard', or 'transposed'")
+    return "transposed" if d <= _SKINNY_D else "standard"
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, bias, scale, block_q, block_k, interpret):
     return _flash_forward_any(q, k, v, bias, scale, block_q, block_k,
@@ -273,7 +293,7 @@ def _flash(q, k, v, bias, scale, block_q, block_k, interpret):
 
 
 def _flash_forward_any(q, k, v, bias, scale, block_q, block_k, interpret):
-    if q.shape[-1] <= _SKINNY_D:
+    if _pick_layout(q.shape[-1]) == "transposed":
         return _flash_forward_t(q, k, v, bias, scale, block_q, block_k,
                                 interpret)
     return _flash_forward(q, k, v, bias, scale, block_q, block_k, interpret)

@@ -43,9 +43,11 @@ for s in $STAGES; do
       run_stage bench timeout 3000 python bench.py ;;
     img)     # secondary metric: MNIST imgs/sec/chip
       run_stage img env BENCH_TASK=img_clf timeout 1800 python bench.py ;;
-    kernels) # flash/chunked/einsum on-chip microbench (VERDICT #2)
+    kernels) # flash/chunked/einsum on-chip microbench (VERDICT #2),
+             # with the flash layout A/B (std vs transposed)
       run_stage kernels env KERNEL_SHAPES="$KSHAPES" \
-        timeout 3000 python scripts/bench_kernels.py ;;
+        timeout 3000 python scripts/bench_kernels.py \
+        einsum chunked flash_std flash_t ;;
     memcheck) # AOT HBM estimates for the two big configs (VERDICT #6)
       run_stage memcheck timeout 1800 python scripts/aot_memcheck.py all ;;
     seg)     # one real 512x512 / 262k-query train step (VERDICT #7)

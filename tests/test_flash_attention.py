@@ -152,6 +152,19 @@ class TestFlash:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize("layout,d", [("standard", 16),
+                                          ("transposed", 64),
+                                          ("transposed", 192)])
+    def test_forced_layout_matches_reference(self, monkeypatch, layout, d):
+        """PERCEIVER_TPU_FLASH_LAYOUT pins the block layout regardless
+        of head dim (the on-chip A/B knob) — numerics must hold in the
+        non-default pairing too, incl. transposed at D > 128."""
+        monkeypatch.setenv("PERCEIVER_TPU_FLASH_LAYOUT", layout)
+        q, k, v = _qkv(jax.random.key(13), lq=32, lk=64, d=d)
+        out = flash_attention(q, k, v, block_q=16, block_k=32)
+        np.testing.assert_allclose(out, _reference_attention(q, k, v),
+                                   atol=1e-5, rtol=1e-5)
+
     def test_skinny_layout_bf16(self):
         """bf16 through the transposed kernel (16-sublane tiles)."""
         q, k, v = (x.astype(jnp.bfloat16) for x in
