@@ -28,6 +28,7 @@ import time
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -140,12 +141,38 @@ def apply_accelerator(accelerator: str) -> None:
 
 
 def _version_dir(root: str, experiment: str) -> str:
-    """logs/{experiment}/version_N — the reference's TB layout."""
+    """logs/{experiment}/version_N — the reference's TB layout.
+
+    Multi-host: every process must agree on N (the checkpoint hook's
+    orbax saves are collectives into this directory), and concurrent
+    listdir races would let hosts pick different numbers — process 0
+    decides, everyone else adopts its choice."""
     base = os.path.join(root, experiment)
     os.makedirs(base, exist_ok=True)
     versions = [int(d.split("_")[1]) for d in os.listdir(base)
                 if d.startswith("version_") and d.split("_")[1].isdigit()]
-    return os.path.join(base, f"version_{max(versions, default=-1) + 1}")
+    n = max(versions, default=-1) + 1
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        n = int(multihost_utils.broadcast_one_to_all(np.int32(n)))
+    return os.path.join(base, f"version_{n}")
+
+
+class _NullWriter:
+    """Rank-nonzero stand-in for SummaryWriter (one host writes TB
+    events; duplicated writers would interleave corrupt event files)."""
+
+    def add_scalar(self, *a, **k):
+        pass
+
+    def add_text(self, *a, **k):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
 
 
 class Trainer:
@@ -219,8 +246,27 @@ class Trainer:
             # per parallel.sharding rules; without a model axis this
             # reduces to full replication (P() everywhere)
             from perceiver_tpu.parallel.sharding import param_sharding
-            state = jax.device_put(state,
-                                   param_sharding(state, self.mesh))
+            shardings = param_sharding(state, self.mesh)
+            if jax.process_count() > 1:
+                # device_put cannot create cross-process global arrays;
+                # every host computed identical full values (same seed),
+                # so each host contributes its addressable shards of
+                # the full array it already holds
+                def to_global(x, s):
+                    if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+                        data = np.asarray(jax.random.key_data(x))
+                        g = jax.make_array_from_process_local_data(
+                            jax.sharding.NamedSharding(
+                                self.mesh, jax.sharding.PartitionSpec()),
+                            data, data.shape)
+                        return jax.random.wrap_key_data(g)
+                    arr = np.asarray(x)
+                    return jax.make_array_from_process_local_data(
+                        s, arr, arr.shape)
+
+                state = jax.tree.map(to_global, state, shardings)
+            else:
+                state = jax.device_put(state, shardings)
         return state
 
     def _shard_batch(self, batch: Dict[str, np.ndarray], *,
@@ -416,7 +462,8 @@ class Trainer:
 
         self._prepare_data()
         self.datamodule.setup()
-        self.writer = SummaryWriter(self.log_dir)
+        self.writer = (SummaryWriter(self.log_dir)
+                       if jax.process_index() == 0 else _NullWriter())
         if cfg.enable_checkpointing:
             self._ckpt = CheckpointHook(
                 os.path.join(self.log_dir, "checkpoints"),
