@@ -1,15 +1,21 @@
 """Worker for the true multi-process distributed test.
 
-Run as: python tests/dist_worker.py <pid> <nproc> <port> <out.json> <data_dir>
+Run as: python tests/dist_worker.py <pid> <nproc> <port> <out.json> \
+            <data_dir> [model_parallel]
 
 Initializes ``jax.distributed`` over the CPU backend (Gloo
 collectives), then trains a tiny MLM through the REAL Trainer path:
 per-host dataset sharding (``set_sharding``), cross-process global
 batch assembly (``make_array_from_process_local_data``), GSPMD
 gradient all-reduce, the multi-host prepare_data barrier, and the
-multi-host eval aggregation. Writes this process's final metrics to
-``out.json`` — the test asserts both processes produced IDENTICAL
-metrics (collective consistency) and that training stepped.
+multi-host eval aggregation. With ``model_parallel > 1`` (each process
+forced to several virtual devices by the caller's XLA_FLAGS), the mesh
+gains a tensor-parallel axis that stays host-internal while the dp
+gradient all-reduce crosses processes — the standard multi-host layout
+(dp over DCN, tp over ICI) in miniature.
+Writes this process's final metrics to ``out.json`` — the test asserts
+both processes produced IDENTICAL metrics (collective consistency) and
+that training stepped.
 """
 
 import json
@@ -22,6 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     pid, nproc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
                                   sys.argv[3], sys.argv[4])
+    model_parallel = int(sys.argv[6]) if len(sys.argv) > 6 else 1
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -31,14 +38,12 @@ def main():
         num_processes=nproc, process_id=pid)
     assert jax.process_count() == nproc
 
-    import numpy as np
-    from jax.sharding import Mesh
-
     from perceiver_tpu.data import IMDBDataModule
+    from perceiver_tpu.parallel import make_mesh
     from perceiver_tpu.tasks import MaskedLanguageModelTask
     from perceiver_tpu.training import Trainer, TrainerConfig
 
-    mesh = Mesh(np.array(jax.devices()), ("data",))
+    mesh = make_mesh(model_parallel=model_parallel)
     task = MaskedLanguageModelTask(
         vocab_size=96, max_seq_len=32, num_latents=8,
         num_latent_channels=16, num_encoder_layers=2,
@@ -57,7 +62,7 @@ def main():
                         enable_checkpointing=True, save_top_k=1,
                         precision="32",
                         default_root_dir=os.path.join(sys.argv[5], "logs"),
-                        experiment="dist")
+                        experiment=f"dist_tp{model_parallel}")
     trainer = Trainer(task, dm, cfg, mesh=mesh)
     state = trainer.fit()
     val = trainer.validate(state)

@@ -29,13 +29,26 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_distributed_training(tmp_path):
+@pytest.mark.parametrize("devices_per_proc,model_parallel", [
+    (1, 1),   # pure dp over 2 processes (the reference's DDP shape)
+    (2, 2),   # dp2×tp2 over 2 procs × 2 virtual devices: dp gradient
+              # all-reduces cross the process boundary while the tp
+              # axis stays host-internal — the standard multi-host
+              # layout (dp over DCN, tp over ICI) in miniature. NOTE:
+              # cross-process tp is deliberately NOT claimed here; the
+              # device order puts each model group inside one process,
+              # matching how real pods lay tp on intra-host links.
+])
+def test_two_process_distributed_training(tmp_path, devices_per_proc,
+                                          model_parallel):
     port = _free_port()
     outs = [tmp_path / f"out_{i}.json" for i in range(2)]
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PERCEIVER_TPU_OFFLINE": "1"}
-    # each process must see exactly ONE local CPU device
     env.pop("XLA_FLAGS", None)
+    if devices_per_proc > 1:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{devices_per_proc}")
     # each worker logs to its own FILE: piping both and draining
     # sequentially can deadlock (a worker blocked writing a full pipe
     # while its peer blocks in a Gloo collective waiting for it), and
@@ -46,18 +59,31 @@ def test_two_process_distributed_training(tmp_path):
             subprocess.Popen(
                 [sys.executable,
                  os.path.join(ROOT, "tests", "dist_worker.py"),
-                 str(i), "2", str(port), str(outs[i]), str(tmp_path)],
+                 str(i), "2", str(port), str(outs[i]), str(tmp_path),
+                 str(model_parallel)],
                 env=env, cwd=ROOT,
                 stdout=log_files[i], stderr=subprocess.STDOUT, text=True)
             for i in range(2)
         ]
+        # fail fast: if one worker dies, its peer hangs in a Gloo
+        # collective waiting for it — kill the peer immediately instead
+        # of burning the full timeout
+        import time
+
+        deadline = time.monotonic() + 600
         try:
-            for p in procs:
-                p.wait(timeout=600)
-        except subprocess.TimeoutExpired:
+            while any(p.poll() is None for p in procs):
+                if time.monotonic() > deadline:
+                    raise subprocess.TimeoutExpired("dist_worker", 600)
+                if any(p.poll() not in (None, 0) for p in procs):
+                    time.sleep(2)  # grace for the peer to exit cleanly
+                    break
+                time.sleep(0.5)
+        finally:
             for q in procs:
-                q.kill()
-            raise
+                if q.poll() is None:
+                    q.kill()
+                    q.wait()
 
         def tail(i):
             log_files[i].seek(0)
