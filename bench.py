@@ -234,6 +234,37 @@ def run_img(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
         detail={"image_shape": [28, 28, 1]})
 
 
+def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
+    """``BENCH_TASK=seg``: the 512×512 / 262,144-output-query LArTPC
+    segmentation config (``run.py:72-112``) — pixels/sec/chip, the
+    decoder-query-chunking + long-kv memory stress config.
+    ``BENCH_SEG_SIZE`` overrides the side length (smoke runs use 64;
+    pinned values are honored exactly, like every other BENCH_* env)."""
+    import jax.numpy as jnp
+
+    from perceiver_tpu.tasks import SegmentationTask
+
+    del loss_impl  # weighted CE over 3 classes; no fused variants
+    side = int(os.environ.get("BENCH_SEG_SIZE", "512"))
+    task = SegmentationTask(image_shape=(side, side, 1),
+                            query_chunk_size=min(16384, side * side))
+    rng = np.random.default_rng(0)
+    stacked = {
+        "image": jnp.asarray(
+            rng.random((inner_steps, batch_size, side, side, 1)) *
+            (rng.random((inner_steps, batch_size, side, side, 1)) < 0.01),
+            jnp.float32),
+        "label": jnp.asarray(rng.integers(
+            0, 3, (inner_steps, batch_size, side, side)), jnp.int32),
+    }
+    return _bench_train(
+        task, stacked, batch_size=batch_size, inner_steps=inner_steps,
+        units_per_step=batch_size * side * side,
+        metric="lartpc_seg_pixels_per_sec_per_chip", unit="pixels/s",
+        detail={"image_shape": [side, side, 1],
+                "num_output_queries": side * side})
+
+
 def main():
     pinned = any(k in os.environ for k in
                  ("BENCH_BATCH", "BENCH_INNER_STEPS", "BENCH_LOSS_IMPL"))
@@ -246,9 +277,14 @@ def main():
     else:
         configs = _LADDER
 
-    runner = run_img if os.environ.get("BENCH_TASK") == "img_clf" else run
-    if runner is run_img:
-        # loss_impl doesn't apply to the classifier — collapse ladder
+    runner = {"img_clf": run_img, "seg": run_seg}.get(
+        os.environ.get("BENCH_TASK", ""), run)
+    if runner is run_seg and not pinned:
+        # the 262k-query config is memory-bound in BATCH, not in
+        # inner_steps — its ladder degrades the axis that matters
+        configs = [(4, 1, "n/a"), (2, 1, "n/a"), (1, 1, "n/a")]
+    elif runner is not run:
+        # loss_impl doesn't apply to these tasks — collapse ladder
         # entries that only differ in it (keep first-seen order)
         seen, deduped = set(), []
         for b, inner, _ in configs:

@@ -5,7 +5,7 @@
 # drop mid-run still leaves the earlier evidence on disk.
 #
 # Usage: scripts/tpu_runbook.sh [stage ...]   (default: all stages)
-# Stages: bench img kernels memcheck seg sweep
+# Stages: bench img kernels memcheck seg segbench sweep
 # RUNBOOK_SMOKE=1 runs every stage on the CPU backend at tiny settings
 # — validates stage wiring without a chip (and without chip-scale cost).
 
@@ -13,7 +13,7 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=logs/tpu_runbook
 SMOKE_ENV=()
-SEG_SIZE=512; SWEEP_ARGS=""; SEG_ACCEL=()
+SEG_SIZE=512; SWEEP_ARGS=""; SEG_ACCEL=(); SEGB_ENV=()
 KSHAPES=mnist,mlm,seg,lm2048
 if [[ "${RUNBOOK_SMOKE:-}" == 1 ]]; then
   OUT=logs/tpu_runbook_smoke
@@ -22,9 +22,10 @@ if [[ "${RUNBOOK_SMOKE:-}" == 1 ]]; then
              SWEEP_IMPLS=packed SWEEP_INNER=1)
   KSHAPES=mnist
   SEG_SIZE=64; SWEEP_ARGS="8"; SEG_ACCEL=(--accelerator cpu)
+  SEGB_ENV=(BENCH_BATCH=1 BENCH_SEG_SIZE=64)
 fi
 mkdir -p "$OUT"
-STAGES=${@:-bench img kernels memcheck seg sweep}
+STAGES=${@:-bench img kernels memcheck seg segbench sweep}
 ts() { date -u +%FT%TZ; }
 
 run_stage() {
@@ -55,6 +56,9 @@ for s in $STAGES; do
         --num-synthetic 8 --batch-size 2 --epochs 1 --val-events 0 \
         "${SEG_ACCEL[@]}" \
         --logdir "$OUT/seg_logs" --ckpt-dir "$OUT/seg_ckpt" ;;
+    segbench) # pixels/sec JSON line for the 262k-query config
+      run_stage segbench env BENCH_TASK=seg "${SEGB_ENV[@]}" \
+        timeout 1800 python bench.py ;;
     sweep)   # batch/inner/loss_impl tuning sweep (longest; last)
       run_stage sweep timeout 6000 python scripts/bench_sweep.py \
         $SWEEP_ARGS ;;
