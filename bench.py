@@ -126,10 +126,11 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
         train_steps, params, opt_state, stacked_batch, key)
     _log("compiled; warming up ...")
     # warmup (compile already done when step_flops_and_fn AOT-compiled)
+    t_warm = time.perf_counter()
     params, opt_state, loss = train_steps(params, opt_state, stacked_batch,
                                           key)
     jax.block_until_ready(loss)
-    _log("warm; timing ...")
+    _log(f"warm ({time.perf_counter() - t_warm:.2f}s); timing ...")
 
     profile_dir = os.environ.get("BENCH_PROFILE")
     if profile_dir:
@@ -138,13 +139,21 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     try:
         n_dispatch = max(20 // inner_steps, 3)
         n_steps = n_dispatch * inner_steps
-        t0 = time.perf_counter()
+        dt = 0.0
         for i in range(n_dispatch):
             key = jax.random.fold_in(key, i)
+            t_i = time.perf_counter()
             params, opt_state, loss = train_steps(params, opt_state,
                                                   stacked_batch, key)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
+            # per-dispatch sync: negligible overhead at these dispatch
+            # sizes, and a hung tunnel shows up as a stalled dispatch i
+            # in the log instead of one silent multi-minute wait. dt
+            # sums only the dispatch+sync segments, so the flushed
+            # stderr log below (potentially slow over a tunnel) stays
+            # out of the measured window.
+            jax.block_until_ready(loss)
+            dt += time.perf_counter() - t_i
+            _log(f"dispatch {i + 1}/{n_dispatch} done (+{dt:.2f}s)")
     finally:
         # always close the trace — a mid-loop OOM must not leave the
         # profiler open (the next ladder config's start_trace would

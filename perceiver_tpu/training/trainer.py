@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import sys
 import time
 from typing import Any, Dict, Optional
 
@@ -603,6 +604,16 @@ class Trainer:
                     self._check_nan(metrics)
                     dt = time.time() - t0
                     throughput = samples_since / max(dt, 1e-9)
+                    if jax.process_index() == 0:
+                        # console heartbeat: progress visibility for
+                        # interactive runs and a liveness signal for
+                        # watchdogs (a stalled device shows up as this
+                        # line going quiet)
+                        print(f"[step {self.global_step}] "
+                              + " ".join(f"{k}={float(v):.4f}"
+                                         for k, v in metrics.items())
+                              + f" samples/s={throughput:.1f}",
+                              file=sys.stderr, flush=True)
                     for k, v in metrics.items():
                         self.writer.add_scalar(f"train_{k}", float(v),
                                                self.global_step)
@@ -648,6 +659,11 @@ class Trainer:
                 val_metrics = self._run_eval(
                     self.datamodule.val_dataloader(), limit_val, state,
                     "val")
+                if val_metrics and jax.process_index() == 0:
+                    print(f"[step {self.global_step}] "
+                          + " ".join(f"{k}={float(v):.4f}"
+                                     for k, v in val_metrics.items()),
+                          file=sys.stderr, flush=True)
                 for k, v in val_metrics.items():
                     self.writer.add_scalar(k, v, self.global_step)
                 if hasattr(self.task, "on_validation_epoch_end"):

@@ -1,0 +1,105 @@
+#!/bin/bash
+# Watch for the axon TPU backend to come up (init AND execute, not just
+# init — 2026-07-31 the tunnel initialized, compiled, then hung forever
+# on the first dispatch) and the moment it does, collect on-chip
+# evidence smallest-first so even a short availability window yields a
+# number. Each step runs in its own process with its own absolute
+# timeout AND a heartbeat-stall watchdog (stderr quiet too long =
+# tunnel died mid-step): a hang costs minutes, not the window.
+#
+# Usage: scripts/tpu_watch_and_run.sh  (designed for nohup/background)
+set -u
+cd "$(dirname "$0")/.."
+OUT=logs/tpu_evidence
+mkdir -p "$OUT"
+LOG="$OUT/watch.log"
+ts() { date -u +%FT%TZ; }
+say() { echo "[$(ts)] $*" >> "$LOG"; }
+
+probe() {
+  # success = backend initializes AND executes a matmul, within 90 s
+  timeout 90 python - <<'EOF' > /dev/null 2>&1
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d[0].platform in ("tpu",), d
+x = jnp.ones((512, 512), jnp.bfloat16)
+(x @ x).block_until_ready()
+EOF
+}
+
+# One evidence step with absolute timeout + output-stall watchdog.
+# $1 = label, $2 = absolute timeout s, $3 = stall timeout s (0 = none,
+# absolute only), rest = command. Progress = growth of $label.err.
+step() {
+  local label=$1 tmo=$2 stall=$3; shift 3
+  if [[ -e "$OUT/$label.done" ]]; then
+    return 0  # already collected in an earlier window
+  fi
+  say "step $label: $*"
+  ( "$@" ) > "$OUT/$label.out" 2> "$OUT/$label.err" &
+  local pid=$! t_start=$SECONDS last_size=-1 last_change=$SECONDS
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 15
+    local now=$SECONDS size
+    size=$(stat -c %s "$OUT/$label.err" 2>/dev/null || echo 0)
+    if [[ "$size" != "$last_size" ]]; then
+      last_size=$size last_change=$now
+    fi
+    if (( now - t_start > tmo )); then
+      say "step $label: absolute timeout ${tmo}s — killing"
+      kill -9 "$pid" 2>/dev/null
+    elif (( stall > 0 && now - last_change > stall )); then
+      say "step $label: no output for ${stall}s — killing (stalled)"
+      kill -9 "$pid" 2>/dev/null
+    fi
+  done
+  wait "$pid"; local rc=$?
+  say "step $label rc=$rc"
+  if [[ $rc -eq 0 ]]; then
+    # bench steps print ONE JSON line on stdout; snapshot it
+    tail -1 "$OUT/$label.out" | grep -q '^{' \
+      && tail -1 "$OUT/$label.out" > "$OUT/$label.json"
+    touch "$OUT/$label.done"
+    return 0
+  fi
+  return 1
+}
+
+say "watcher started (pid $$)"
+while true; do
+  if ! probe; then
+    say "probe: backend down"
+    sleep 150
+    continue
+  fi
+  say "probe: BACKEND UP — collecting evidence"
+
+  # Priority order, smallest/fastest first. || continue goes back to
+  # probing as soon as a step fails so we do not burn a dead tunnel.
+  step bench_b64    480  240 env BENCH_BATCH=64  BENCH_INNER_STEPS=1 BENCH_LOSS_IMPL=packed python bench.py || continue
+  step bench_b256   600  240 env BENCH_BATCH=256 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
+  step bench_b512   720  300 env BENCH_BATCH=512 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
+  step img_b256     600  240 env BENCH_TASK=img_clf BENCH_BATCH=256 BENCH_INNER_STEPS=8 python bench.py || continue
+  step kernels_mlm  900  420 env KERNEL_SHAPES=mnist,mlm KERNEL_REPS=20 python scripts/bench_kernels.py einsum chunked flash_std flash_t || continue
+  step kernels_seg 1200  600 env KERNEL_SHAPES=seg,lm2048 KERNEL_REPS=10 python scripts/bench_kernels.py einsum chunked flash_std flash_t || continue
+  step memcheck     900  600 python scripts/aot_memcheck.py all || continue
+  step seg_step    1200  600 python run.py --size 512 --num-synthetic 8 --batch-size 2 --epochs 1 --val-events 0 --logdir "$OUT/seg_logs" --ckpt-dir "$OUT/seg_ckpt" || continue
+  step segbench    1200  600 env BENCH_TASK=seg BENCH_BATCH=2 BENCH_INNER_STEPS=1 python bench.py || continue
+  step bench_b1024  900  300 env BENCH_BATCH=1024 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
+  step sweep       4800  600 python scripts/bench_sweep.py || continue
+  # long tail: real-text MLM quality training (resumable across
+  # windows via mlm_quality_run.sh's newest-checkpoint lookup), then
+  # the two-phase seq_clf transfer on its best checkpoint
+  step mlm_quality 14400 900 bash scripts/mlm_quality_run.sh 50000 || continue
+  step clf_phase1  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache \
+      --model.mlm_ckpt="$(ls -dt logs/mlm_tpu_quality/version_*/checkpoints 2>/dev/null | head -1)" \
+      --model.freeze_encoder=true --trainer.max_steps=3000 \
+      --trainer.steps_per_execution=8 --experiment=clf_tpu_phase1 || continue
+  step clf_phase2  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache \
+      --model.clf_ckpt="$(ls -dt logs/clf_tpu_phase1/version_*/checkpoints 2>/dev/null | head -1)" \
+      --optimizer.init_args.lr=0.0001 --trainer.max_steps=1500 \
+      --trainer.steps_per_execution=8 --experiment=clf_tpu_phase2 || continue
+  say "ALL EVIDENCE COLLECTED"
+  break
+done
+say "watcher exiting"
