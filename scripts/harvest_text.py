@@ -14,9 +14,12 @@ Sources (all local, no egress):
 Documents are cleaned to prose-looking paragraphs, deduplicated,
 shuffled deterministically, and written as
 ``{out}/aclImdb/{train,test}/{pos,neg}/{i}_{score}.txt`` — the layout
-``perceiver_tpu.data.imdb.load_split`` reads. Labels carry no
-sentiment signal (docs are split round-robin), so this corpus is for
-MLM quality evidence, not classification benchmarks.
+``perceiver_tpu.data.imdb.load_split`` reads. Labels are a real,
+learnable binary signal — API/reference-style text (parameter/return/
+class vocabulary) vs narrative prose — downsampled to balance, so the
+seq_clf transfer recipe can demonstrate genuine classification on this
+corpus, not just MLM. (Not sentiment, but the same task shape as IMDB:
+binary document classification over natural English.)
 
 Usage: python scripts/harvest_text.py [--out .cache] [--max-docs N]
 """
@@ -27,6 +30,7 @@ import hashlib
 import os
 import random
 import re
+import shutil
 import sys
 
 _WORD = re.compile(r"[A-Za-z][a-z]+")
@@ -147,20 +151,41 @@ def main():
     n_test = max(len(docs) // 20, 1)
     splits = {"test": docs[:n_test], "train": docs[n_test:]}
     total_bytes = 0
+    # a prior harvest (possibly differently labeled) must not leave
+    # stale files mixed into this one
+    shutil.rmtree(os.path.join(args.out, "aclImdb"), ignore_errors=True)
+    n_dropped = 0
+    api_words = re.compile(
+        r"\b(parameter|argument|returns?|default|callable|iterable|"
+        r"instance|attribute|keyword|deprecated|subclass|dtype|"
+        r"specify|specified|optional)\b", re.IGNORECASE)
     for split, items in splits.items():
         for label in ("neg", "pos"):
             os.makedirs(os.path.join(args.out, "aclImdb", split, label),
                         exist_ok=True)
-        for i, doc in enumerate(items):
-            label = ("neg", "pos")[i % 2]
-            path = os.path.join(args.out, "aclImdb", split, label,
-                                f"{i}_{5 + (i % 2) * 5}.txt")
+        # label 1 (pos) = API/reference-style text, 0 (neg) = narrative
+        # prose; balance by downsampling the majority class
+        labeled = [(doc, int(bool(api_words.search(doc))))
+                   for doc in items]
+        by_label = {0: [d for d, y in labeled if y == 0],
+                    1: [d for d, y in labeled if y == 1]}
+        n_keep = min(len(by_label[0]), len(by_label[1]))
+        n_dropped += len(labeled) - 2 * n_keep
+        kept = [(d, 0) for d in by_label[0][:n_keep]] + \
+               [(d, 1) for d in by_label[1][:n_keep]]
+        random.Random(1).shuffle(kept)
+        for i, (doc, y) in enumerate(kept):
+            path = os.path.join(args.out, "aclImdb", split,
+                                ("neg", "pos")[y],
+                                f"{i}_{5 + y * 5}.txt")
             with open(path, "w", encoding="utf-8") as f:
                 f.write(doc)
             total_bytes += len(doc)
-    print(f"wrote {len(docs)} docs ({total_bytes / 1e6:.1f} MB) "
-          f"to {args.out}/aclImdb "
-          f"(train {len(splits['train'])}, test {len(splits['test'])})")
+        splits[split] = kept
+    print(f"wrote {sum(len(v) for v in splits.values())} docs "
+          f"({total_bytes / 1e6:.1f} MB) to {args.out}/aclImdb "
+          f"(train {len(splits['train'])}, test {len(splits['test'])}, "
+          f"dropped {n_dropped} for class balance)")
 
 
 if __name__ == "__main__":

@@ -12,6 +12,9 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=logs/tpu_evidence
 mkdir -p "$OUT"
+# persistent compile cache: repeated windows (and the resume of the
+# quality run) skip recompiles of unchanged programs
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 LOG="$OUT/watch.log"
 ts() { date -u +%FT%TZ; }
 say() { echo "[$(ts)] $*" >> "$LOG"; }
