@@ -32,7 +32,9 @@ EOF
 
 # One evidence step with absolute timeout + output-stall watchdog.
 # $1 = label, $2 = absolute timeout s, $3 = stall timeout s (0 = none,
-# absolute only), rest = command. Progress = growth of $label.err.
+# absolute only), rest = command. Progress = growth of $label.out or
+# $label.err (bench logs progress on stderr; the sweep prints per-point
+# results on stdout with a silent stderr — watch both).
 step() {
   local label=$1 tmo=$2 stall=$3; shift 3
   if [[ -e "$OUT/$label.done" ]]; then
@@ -44,7 +46,8 @@ step() {
   while kill -0 "$pid" 2>/dev/null; do
     sleep 15
     local now=$SECONDS size
-    size=$(stat -c %s "$OUT/$label.err" 2>/dev/null || echo 0)
+    size=$(( $(stat -c %s "$OUT/$label.err" 2>/dev/null || echo 0) +
+             $(stat -c %s "$OUT/$label.out" 2>/dev/null || echo 0) ))
     if [[ "$size" != "$last_size" ]]; then
       last_size=$size last_change=$now
     fi
