@@ -1,19 +1,21 @@
 #!/usr/bin/env python
-"""AOT memory check for the two big BASELINE configs (VERDICT r1 #6).
+"""AOT memory check for the big BASELINE configs (VERDICT r1 #6).
 
 Compiles (compile ONLY — no execution) the full train step of:
 
 1. the 224×224 / 512-latent classifier preset (BASELINE configs[3],
-   v5e-8 target) at its per-chip batch shard, and
+   v5e-8 target) at its per-chip batch shard,
 2. the v5p-16 Perceiver-LM MLM preset (1024×512 latents, 12 self-attn
    layers/block, seq 2048; BASELINE configs[4]) at its per-chip shard,
+3. (``bench``) the headline bench MLM config at the big ladder batch
+   sizes (512, 1024) — predicts whether those rungs fit HBM,
 
 on whatever single device is available, and reports XLA's HBM usage
 estimates (argument/output/temp/generated-code sizes). This validates
 that remat + query chunking keep the per-chip footprint inside a
 v5e/v5p chip's HBM before any pod time is spent.
 
-Usage: python scripts/aot_memcheck.py [224 | lm | all]
+Usage: python scripts/aot_memcheck.py [224 | lm | bench | all]
 Env:   MEMCHECK_PLATFORM=cpu   (forces the CPU backend for smoke runs)
 """
 
@@ -132,6 +134,23 @@ def check_lm(per_chip_batch: int = 2):
     return _compile_train_step(task, batch, "lm")
 
 
+def check_mlm_bench(batch: int):
+    """The headline bench config (bench.py: seq 512, vocab 10003,
+    64×64 latents, packed CE) at a candidate batch size — predicts
+    whether the big ladder rungs fit HBM before chip time is spent."""
+    import jax.numpy as jnp
+
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    task = MaskedLanguageModelTask(vocab_size=10003, max_seq_len=512,
+                                   loss_impl="packed")
+    batch_arrs = {
+        "input_ids": jnp.zeros((batch, 512), jnp.int32),
+        "pad_mask": jnp.zeros((batch, 512), bool),
+    }
+    return _compile_train_step(task, batch_arrs, f"mlm_b{batch}")
+
+
 def main():
     import jax
 
@@ -145,6 +164,9 @@ def main():
         out["classifier_224"] = check_224()
     if which in ("lm", "all"):
         out["perceiver_lm_v5p16_shard"] = check_lm()
+    if which in ("bench", "all"):
+        for b in (512, 1024):
+            out[f"mlm_bench_b{b}"] = check_mlm_bench(b)
     print(json.dumps(out, indent=2))
 
 

@@ -33,7 +33,7 @@ for b, impl, inner in itertools.product(BATCHES, IMPLS, INNER):
             env=env, capture_output=True, text=True, timeout=900)
         if out.returncode != 0:
             tail = "\n".join(out.stderr.splitlines()[-4:])
-            print(f"{tag}: FAILED rc={out.returncode}\n{tail}")
+            print(f"{tag}: FAILED rc={out.returncode}\n{tail}", flush=True)
             continue
         line = [ln for ln in out.stdout.splitlines()
                 if ln.startswith("{")][-1]
@@ -41,11 +41,12 @@ for b, impl, inner in itertools.product(BATCHES, IMPLS, INNER):
         tps = r["value"]
         print(f"{tag}: {tps:12.1f} tokens/s  "
               f"mfu={r['detail'].get('mfu')}  "
-              f"step={1000 / r['detail']['steps_per_sec']:.1f} ms")
+              f"step={1000 / r['detail']['steps_per_sec']:.1f} ms",
+              flush=True)
         if best is None or tps > best[1]:
             best = ((b, impl, inner), tps)
     except Exception as e:  # noqa: BLE001 — report and keep sweeping
-        print(f"{tag}: FAILED ({e})")
+        print(f"{tag}: FAILED ({e})", flush=True)
 
 if best:
     (b, impl, inner), tps = best
