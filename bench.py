@@ -24,6 +24,7 @@ MNIST imgs/sec/chip with the ``scripts/img_clf.py`` model config
 import json
 import os
 import sys
+import threading
 import time
 from functools import partial
 
@@ -46,9 +47,54 @@ _LADDER = [
 def _log(msg: str) -> None:
     print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}",
           file=sys.stderr, flush=True)
+    _WATCHDOG.kick()
 
 
 _T0 = time.monotonic()
+
+
+class _Watchdog:
+    """Hard-exit if no progress for BENCH_WATCHDOG seconds (0 disables).
+
+    A half-dead tunnel (backend initializes, first dispatch never
+    completes — observed 2026-07-31) blocks the main thread inside
+    ``block_until_ready``, where Python signal handlers cannot run; a
+    daemon thread + ``os._exit`` is the only reliable escape. Progress
+    is "a _log line was printed": init, compile, warmup, and every
+    timed dispatch all log, so any healthy phase keeps the clock fresh.
+    """
+
+    def __init__(self):
+        self.timeout = float(os.environ.get("BENCH_WATCHDOG", "600"))
+        self._last = time.monotonic()
+        self._allow = self.timeout
+        if self.timeout > 0:
+            threading.Thread(target=self._run, daemon=True).start()
+
+    def kick(self):
+        self._last = time.monotonic()
+        self._allow = self.timeout
+
+    def allow(self, seconds: float):
+        """Grant the CURRENT phase a larger no-progress budget (a cold
+        XLA compile of the big configs can legitimately exceed the
+        dispatch-phase timeout with no intermediate log lines)."""
+        self._last = time.monotonic()
+        self._allow = max(self.timeout, seconds)
+
+    def _run(self):
+        while True:
+            time.sleep(5)
+            idle = time.monotonic() - self._last
+            if idle > self._allow:
+                print(f"[bench] WATCHDOG: no progress for {idle:.0f}s "
+                      f"(> {self._allow:.0f}s) — device or tunnel "
+                      f"presumed dead, exiting", file=sys.stderr,
+                      flush=True)
+                os._exit(3)
+
+
+_WATCHDOG = _Watchdog()
 
 
 def probe_backend() -> None:
@@ -122,6 +168,7 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     # optimizer step — use as-is (verified on the CPU backend: the
     # number is invariant in inner_steps).
     _log("tracing + compiling train_steps ...")
+    _WATCHDOG.allow(3 * _WATCHDOG.timeout)  # cold compiles are slow
     step_flops, train_steps = step_flops_and_fn(
         train_steps, params, opt_state, stacked_batch, key)
     _log("compiled; warming up ...")

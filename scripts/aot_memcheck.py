@@ -7,8 +7,9 @@ Compiles (compile ONLY — no execution) the full train step of:
    v5e-8 target) at its per-chip batch shard,
 2. the v5p-16 Perceiver-LM MLM preset (1024×512 latents, 12 self-attn
    layers/block, seq 2048; BASELINE configs[4]) at its per-chip shard,
-3. (``bench``) the headline bench MLM config at the big ladder batch
-   sizes (512, 1024) — predicts whether those rungs fit HBM,
+3. (``bench``) the headline bench MLM config at batch 512 (the top
+   ``bench.py`` ladder rung) and 1024 (a sweep/watcher point beyond
+   the ladder) — predicts whether those fit HBM,
 
 on whatever single device is available, and reports XLA's HBM usage
 estimates (argument/output/temp/generated-code sizes). This validates
