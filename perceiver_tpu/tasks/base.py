@@ -40,6 +40,19 @@ class TaskConfig:
     # "ulysses"
     attention_impl: Optional[str] = None
     kv_chunk_size: int = 1024
+    # import a trained reference (PyTorch / PyTorch-Lightning)
+    # checkpoint as this task's full model — the migration path for
+    # reference users (reference README.md:72-74; utils/torch_import)
+    torch_ckpt: Optional[str] = None
+
+    def restore_pretrained(self, params):
+        """``torch_ckpt`` → whole-model import of a trained reference
+        checkpoint (key contract: utils/torch_import). Subclasses with
+        richer transfer flags override and fall back to this."""
+        if self.torch_ckpt is None:
+            return params
+        from perceiver_tpu.utils.torch_import import restore_from_torch
+        return restore_from_torch(self.torch_ckpt, template=params)
 
     def __post_init__(self):
         # fail at config time, not deep inside a jit trace: attention-

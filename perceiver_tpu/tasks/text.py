@@ -23,6 +23,7 @@ class TextClassifierTask(TaskConfig):
     freeze_encoder: bool = False
     mlm_ckpt: Optional[str] = None
     clf_ckpt: Optional[str] = None
+    torch_mlm_ckpt: Optional[str] = None
 
     # same token layout as the MLM task (shared encoder)
     seq_partition_fields = ("input_ids", "pad_mask")
@@ -42,8 +43,20 @@ class TextClassifierTask(TaskConfig):
 
     def restore_pretrained(self, params):
         """Apply mlm_ckpt/clf_ckpt transfer (lightning.py:144-149):
-        mlm_ckpt → copy the encoder subtree; clf_ckpt → whole model."""
+        mlm_ckpt → copy the encoder subtree; clf_ckpt → whole model.
+        ``torch_mlm_ckpt`` does the encoder-subtree transfer from a
+        trained reference (PyTorch Lightning) MLM checkpoint instead —
+        the migration path for reference users."""
         from perceiver_tpu.training.checkpoint import restore_params
+        if self.torch_mlm_ckpt is not None:
+            from perceiver_tpu.utils.torch_import import (
+                assert_tree_matches,
+                restore_from_torch,
+            )
+            mlm_params = restore_from_torch(self.torch_mlm_ckpt)
+            assert_tree_matches(mlm_params["encoder"], params["encoder"],
+                                "params.encoder")
+            return {**params, "encoder": mlm_params["encoder"]}
         if self.mlm_ckpt is not None:
             # cross-model restore (MLM decoder ≠ classifier decoder):
             # untyped metadata restore, then take the encoder subtree
@@ -52,7 +65,9 @@ class TextClassifierTask(TaskConfig):
         if self.clf_ckpt is not None:
             # same model — typed restore against our own params
             return restore_params(self.clf_ckpt, template=params)
-        return params
+        # base handles torch_ckpt (whole-model import of a trained
+        # reference classifier checkpoint)
+        return super().restore_pretrained(params)
 
     def frozen_param_labels(self, params):
         """'frozen'/'trainable' label pytree for optax.multi_transform —

@@ -1,0 +1,304 @@
+"""Import the reference's PyTorch / PyTorch-Lightning checkpoints.
+
+The reference publishes trained Lightning checkpoints for its MLM and
+classifier recipes (reference ``README.md:72-74``); this module converts
+their ``state_dict`` into this framework's parameter pytree so a
+reference user can bring trained weights along when switching.
+
+Key-path contract (derived from the reference module tree; see
+``/root/reference/perceiver/model.py`` — attribute names cited inline):
+
+* ``encoder.input_adapter.text_embedding.weight`` / ``.pos_encoding``
+  (``adapter.py:116-117``) → ``encoder.input_adapter.embed`` / ``pos``
+* ``encoder.latent`` (``model.py:169``) → ``encoder.latent``
+* per perceiver layer (``model.py:150-166``: ``layer_1``, ``layer_n``;
+  each ``Sequential(cross_attention_layer, self_attention_block)``):
+
+  - ``<L>.0.0.module`` = Residual(CrossAttention): ``q_norm``/``kv_norm``
+    (``model.py:89-90``) + ``attention.attention`` =
+    ``nn.MultiheadAttention`` (``model.py:66``)
+  - ``<L>.0.1.module`` = Residual(mlp): Sequential indices 0 (LayerNorm),
+    1, 3 (Linear) (``model.py:20-26``)
+  - ``<L>.1.<i>.0.module`` = Residual(SelfAttention): ``norm`` +
+    ``attention.attention``; ``<L>.1.<i>.1.module`` = Residual(mlp)
+
+* ``decoder.output`` (``model.py:222``) → ``decoder.query``
+* ``decoder.cross_attention.{0,1}.module`` (``model.py:217``) →
+  ``decoder.cross``
+* ``decoder.output_adapter.linear`` (``adapter.py:146``) →
+  ``decoder.output_adapter.linear``
+
+``nn.MultiheadAttention`` stores a packed ``in_proj_weight`` (3E, E)
+when q/k/v widths agree, else separate ``{q,k,v}_proj_weight``; biases
+are always the packed ``in_proj_bias`` (3E). torch ``Linear`` weights
+are (out, in) and compute ``x @ W.T + b``; this framework stores
+(in, out) computing ``x @ w + b`` — so every weight matrix transposes.
+Head splitting is contiguous-chunk in both (reshape to (..., H, E/H)),
+so no per-head permutation is needed.
+"""
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "assert_tree_matches",
+    "convert_encoder",
+    "convert_perceiver_params",
+    "load_lightning_state_dict",
+    "restore_from_torch",
+]
+
+
+def _t(w) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(w).T)
+
+
+def _a(w) -> np.ndarray:
+    return np.asarray(w)
+
+
+class _SD:
+    """A consumable view of a torch state dict (numpy leaves): every
+    ``take`` removes the key, so unconsumed keys can be reported."""
+
+    def __init__(self, sd: Dict[str, np.ndarray]):
+        self.sd = dict(sd)
+
+    def take(self, key: str) -> np.ndarray:
+        try:
+            return self.sd.pop(key)
+        except KeyError:
+            raise KeyError(
+                f"reference checkpoint is missing key {key!r}; "
+                f"nearby keys: "
+                f"{[k for k in self.sd if k.startswith(key.split('.')[0])][:8]}"
+            ) from None
+
+    def has(self, key: str) -> bool:
+        return key in self.sd
+
+    def leftover(self, prefix: str = ""):
+        return [k for k in self.sd if k.startswith(prefix)]
+
+
+def _convert_mha(sd: _SD, prefix: str) -> dict:
+    """``nn.MultiheadAttention`` params at ``prefix`` → our ``mha``."""
+    if sd.has(prefix + "in_proj_weight"):
+        w = _a(sd.take(prefix + "in_proj_weight"))  # (3E, E)
+        e = w.shape[0] // 3
+        qw, kw, vw = (_t(w[i * e:(i + 1) * e]) for i in range(3))
+    else:
+        qw = _t(sd.take(prefix + "q_proj_weight"))
+        kw = _t(sd.take(prefix + "k_proj_weight"))
+        vw = _t(sd.take(prefix + "v_proj_weight"))
+    b = _a(sd.take(prefix + "in_proj_bias"))
+    e = b.shape[0] // 3
+    return {
+        "q": {"w": qw, "b": b[:e]},
+        "k": {"w": kw, "b": b[e:2 * e]},
+        "v": {"w": vw, "b": b[2 * e:]},
+        "out": {"w": _t(sd.take(prefix + "out_proj.weight")),
+                "b": _a(sd.take(prefix + "out_proj.bias"))},
+    }
+
+
+def _convert_mlp(sd: _SD, prefix: str) -> dict:
+    """Residual(mlp) at ``prefix`` (Sequential LN→Linear→GELU→Linear,
+    reference ``model.py:20-26``) → our ``mlp``."""
+    return {
+        "norm": {"scale": _a(sd.take(prefix + "0.weight")),
+                 "bias": _a(sd.take(prefix + "0.bias"))},
+        "fc1": {"w": _t(sd.take(prefix + "1.weight")),
+                "b": _a(sd.take(prefix + "1.bias"))},
+        "fc2": {"w": _t(sd.take(prefix + "3.weight")),
+                "b": _a(sd.take(prefix + "3.bias"))},
+    }
+
+
+def _convert_cross_layer(sd: _SD, prefix: str) -> dict:
+    """cross_attention_layer at ``prefix`` (reference ``model.py:29-33``)
+    → our ``{"attn": ..., "mlp": ...}``."""
+    attn = {
+        "norm_q": {"scale": _a(sd.take(prefix + "0.module.q_norm.weight")),
+                   "bias": _a(sd.take(prefix + "0.module.q_norm.bias"))},
+        "norm_kv": {"scale": _a(sd.take(prefix + "0.module.kv_norm.weight")),
+                    "bias": _a(sd.take(prefix + "0.module.kv_norm.bias"))},
+        "mha": _convert_mha(sd, prefix + "0.module.attention.attention."),
+    }
+    return {"attn": attn, "mlp": _convert_mlp(sd, prefix + "1.module.")}
+
+
+def _convert_self_block(sd: _SD, prefix: str) -> dict:
+    """self_attention_block at ``prefix`` (reference ``model.py:43-44``)
+    → our stacked ``selfs`` subtree (leading axis = layer index, the
+    ``lax.scan`` layout)."""
+    per_layer = []
+    i = 0
+    while sd.has(f"{prefix}{i}.0.module.norm.weight"):
+        p = f"{prefix}{i}."
+        per_layer.append({
+            "attn": {
+                "norm": {"scale": _a(sd.take(p + "0.module.norm.weight")),
+                         "bias": _a(sd.take(p + "0.module.norm.bias"))},
+                "mha": _convert_mha(sd, p + "0.module.attention.attention."),
+            },
+            "mlp": _convert_mlp(sd, p + "1.module."),
+        })
+        i += 1
+    if not per_layer:
+        raise KeyError(f"no self-attention layers found under {prefix!r}")
+    stacked = {}
+
+    def _stack(trees, out):
+        for k in trees[0]:
+            if isinstance(trees[0][k], dict):
+                out[k] = {}
+                _stack([t[k] for t in trees], out[k])
+            else:
+                out[k] = np.stack([t[k] for t in trees])
+
+    _stack(per_layer, stacked)
+    # our layout nests attn/mlp with stacked leaves
+    return stacked
+
+
+def _convert_perceiver_layer(sd: _SD, prefix: str) -> dict:
+    return {
+        "cross": _convert_cross_layer(sd, prefix + "0."),
+        "selfs": _convert_self_block(sd, prefix + "1."),
+    }
+
+
+def convert_encoder(sd: Dict[str, np.ndarray],
+                    prefix: str = "encoder.") -> dict:
+    """Convert a reference ``PerceiverEncoder`` state-dict subtree."""
+    s = _SD({k: v for k, v in sd.items() if k.startswith(prefix)})
+    out = {"latent": _a(s.take(prefix + "latent"))}
+    ia = {}
+    if s.has(prefix + "input_adapter.text_embedding.weight"):
+        ia["embed"] = _a(s.take(prefix +
+                                "input_adapter.text_embedding.weight"))
+        ia["pos"] = _a(s.take(prefix + "input_adapter.pos_encoding"))
+    if s.has(prefix + "input_adapter.position_encoding"):
+        # image adapter's precomputed Fourier buffer — we recompute it
+        s.take(prefix + "input_adapter.position_encoding")
+    # always present: the framework template carries an (empty)
+    # input_adapter subtree even for adapters with no learned params
+    out["input_adapter"] = ia
+    out["layer_1"] = _convert_perceiver_layer(s, prefix + "layer_1.")
+    if s.has(prefix + "layer_n.0.0.module.q_norm.weight"):
+        out["layer_n"] = _convert_perceiver_layer(s, prefix + "layer_n.")
+    left = s.leftover()
+    if left:
+        raise ValueError(f"unconverted reference encoder keys: {left}")
+    return out
+
+
+def convert_perceiver_params(sd: Dict[str, np.ndarray],
+                             prefix: Optional[str] = None) -> dict:
+    """Convert a full reference PerceiverIO/PerceiverMLM state dict
+    (e.g. a Lightning checkpoint's ``state_dict``) to this framework's
+    ``{"encoder": ..., "decoder": ...}`` parameter pytree.
+
+    ``prefix=None`` auto-detects where the model lives in the dict:
+    ``model.`` (Lightning tasks, ``lightning.py:96``), ``perceiver.``
+    (the ``run.py`` LAr_Perceiver save, ``run.py:102,278-281``), or
+    bare ``encoder.…`` keys (a directly saved PerceiverIO)."""
+    if prefix is None:
+        for cand in ("model.", "perceiver.", ""):
+            if (cand + "encoder.latent") in sd:
+                prefix = cand
+                break
+        else:
+            raise ValueError(
+                "could not locate 'encoder.latent' under any known "
+                "prefix ('model.', 'perceiver.', '') — keys look like: "
+                f"{sorted(sd)[:8]}")
+    sd = {k[len(prefix):]: v for k, v in sd.items()
+          if k.startswith(prefix)}
+    enc = convert_encoder(sd)
+    s = _SD({k: v for k, v in sd.items() if k.startswith("decoder.")})
+    dec = {
+        "query": _a(s.take("decoder.output")),
+        "cross": _convert_cross_layer(s, "decoder.cross_attention."),
+        "output_adapter": {
+            "linear": {
+                "w": _t(s.take("decoder.output_adapter.linear.weight")),
+                "b": _a(s.take("decoder.output_adapter.linear.bias")),
+            },
+        },
+    }
+    left = s.leftover()
+    if left:
+        raise ValueError(f"unconverted reference decoder keys: {left}")
+    return {"encoder": enc, "decoder": dec}
+
+
+def load_lightning_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Load a Lightning ``.ckpt`` (or bare ``torch.save``d state dict /
+    ``run.py``-style dict with ``model_state_dict``) as numpy arrays.
+
+    Tries torch's safe ``weights_only=True`` first. Reference-era
+    Lightning 1.5 checkpoints pickle Lightning objects alongside the
+    tensors, which the safe loader rejects; set
+    ``PERCEIVER_TPU_TRUST_TORCH_CKPT=1`` to permit a full unpickle —
+    only for checkpoints you trust (unpickling executes code).
+    """
+    import os
+
+    import torch
+
+    try:
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+    except Exception as safe_err:  # noqa: BLE001 — explain the knob
+        if os.environ.get("PERCEIVER_TPU_TRUST_TORCH_CKPT") == "1":
+            obj = torch.load(path, map_location="cpu",
+                             weights_only=False)
+        else:
+            raise ValueError(
+                f"safe (weights_only) load of {path!r} failed: "
+                f"{safe_err}\nLightning-era checkpoints pickle "
+                f"framework objects next to the tensors; if you trust "
+                f"this file, set PERCEIVER_TPU_TRUST_TORCH_CKPT=1 to "
+                f"allow a full unpickle.") from safe_err
+    if isinstance(obj, dict):
+        if "state_dict" in obj:          # Lightning checkpoint
+            obj = obj["state_dict"]
+        elif "model_state_dict" in obj:  # reference run.py:278-281 save
+            obj = obj["model_state_dict"]
+    return {k: v.detach().cpu().numpy() for k, v in obj.items()
+            if hasattr(v, "detach")}
+
+
+def assert_tree_matches(converted, template, path="params") -> None:
+    """Raise if the converted tree's structure/shapes differ from the
+    framework-initialized template (catches config mismatches loudly
+    instead of at the first jitted apply)."""
+    if isinstance(template, dict):
+        if not isinstance(converted, dict):
+            raise ValueError(f"{path}: expected subtree, got leaf")
+        missing = set(template) - set(converted)
+        extra = set(converted) - set(template)
+        if missing or extra:
+            raise ValueError(f"{path}: missing keys {sorted(missing)}, "
+                             f"unexpected keys {sorted(extra)}")
+        for k in template:
+            assert_tree_matches(converted[k], template[k], f"{path}.{k}")
+    else:
+        t_shape = tuple(getattr(template, "shape", ()))
+        c_shape = tuple(np.shape(converted))
+        if t_shape != c_shape:
+            raise ValueError(f"{path}: shape {c_shape} != expected "
+                             f"{t_shape} (checkpoint/config mismatch?)")
+
+
+def restore_from_torch(path: str, template: Optional[dict] = None,
+                       prefix: Optional[str] = None) -> dict:
+    """One-call import: load + convert (+ validate against a template
+    pytree from ``model.init`` when given), returning numpy leaves."""
+    params = convert_perceiver_params(load_lightning_state_dict(path),
+                                      prefix=prefix)
+    if template is not None:
+        assert_tree_matches(params, template)
+    return params
