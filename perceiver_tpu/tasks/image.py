@@ -5,7 +5,7 @@
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 from perceiver_tpu.adapters import (
     ClassificationOutputAdapter,

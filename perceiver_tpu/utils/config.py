@@ -306,9 +306,11 @@ class CLI:
                 raise SystemExit(
                     f"{self.task_cls.__name__} has no predict path "
                     "(only the MLM task does)")
-            if not self.config.get("ckpt_path"):
+            if not self.config.get("ckpt_path") and \
+                    not (self.config.get("model") or {}).get("torch_ckpt"):
                 raise SystemExit(
-                    "predict requires --ckpt_path=<trained checkpoint>")
+                    "predict requires --ckpt_path=<trained checkpoint> "
+                    "(or --model.torch_ckpt=<reference checkpoint>)")
             if not (self.config.get("model") or {}).get("masked_samples"):
                 raise SystemExit(
                     "predict requires --model.masked_samples")

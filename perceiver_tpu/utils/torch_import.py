@@ -148,19 +148,10 @@ def _convert_self_block(sd: _SD, prefix: str) -> dict:
         i += 1
     if not per_layer:
         raise KeyError(f"no self-attention layers found under {prefix!r}")
-    stacked = {}
+    import jax
 
-    def _stack(trees, out):
-        for k in trees[0]:
-            if isinstance(trees[0][k], dict):
-                out[k] = {}
-                _stack([t[k] for t in trees], out[k])
-            else:
-                out[k] = np.stack([t[k] for t in trees])
-
-    _stack(per_layer, stacked)
-    # our layout nests attn/mlp with stacked leaves
-    return stacked
+    # leading axis = layer index (the lax.scan layout)
+    return jax.tree.map(lambda *xs: np.stack(xs), *per_layer)
 
 
 def _convert_perceiver_layer(sd: _SD, prefix: str) -> dict:
@@ -217,6 +208,15 @@ def convert_perceiver_params(sd: Dict[str, np.ndarray],
                 f"{sorted(sd)[:8]}")
     sd = {k[len(prefix):]: v for k, v in sd.items()
           if k.startswith(prefix)}
+    # loud-failure contract: trained weights outside the encoder/
+    # decoder subtrees (there are none in any reference model — masking
+    # and the metrics have no params) must not vanish silently
+    stray = [k for k in sd
+             if not k.startswith(("encoder.", "decoder."))]
+    if stray:
+        raise ValueError(
+            f"checkpoint keys under prefix {prefix!r} outside "
+            f"encoder./decoder. would be dropped: {stray[:8]}")
     enc = convert_encoder(sd)
     s = _SD({k: v for k, v in sd.items() if k.startswith("decoder.")})
     dec = {
