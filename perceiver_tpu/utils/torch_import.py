@@ -45,6 +45,7 @@ __all__ = [
     "assert_tree_matches",
     "convert_encoder",
     "convert_perceiver_params",
+    "export_perceiver_params",
     "load_lightning_state_dict",
     "restore_from_torch",
 ]
@@ -195,19 +196,37 @@ def convert_perceiver_params(sd: Dict[str, np.ndarray],
     ``prefix=None`` auto-detects where the model lives in the dict:
     ``model.`` (Lightning tasks, ``lightning.py:96``), ``perceiver.``
     (the ``run.py`` LAr_Perceiver save, ``run.py:102,278-281``), or
-    bare ``encoder.…`` keys (a directly saved PerceiverIO)."""
+    bare keys (a directly saved model).
+
+    Child naming differs by model family: ``PerceiverMLM`` registers
+    named ``self.encoder``/``self.decoder`` attributes
+    (``model.py:296-304``), but ``PerceiverIO`` subclasses
+    ``nn.Sequential`` (``model.py:321-325``, ``utils.py:7``), whose
+    children serialize as ``0.``/``1.`` — every real classifier and
+    ``run.py`` checkpoint uses the numeric form. Both are accepted;
+    numeric children are normalized to ``encoder.``/``decoder.``."""
     if prefix is None:
         for cand in ("model.", "perceiver.", ""):
-            if (cand + "encoder.latent") in sd:
+            if (cand + "encoder.latent") in sd or (cand + "0.latent") in sd:
                 prefix = cand
                 break
         else:
             raise ValueError(
-                "could not locate 'encoder.latent' under any known "
-                "prefix ('model.', 'perceiver.', '') — keys look like: "
+                "could not locate 'encoder.latent' (or the Sequential "
+                "form '0.latent') under any known prefix ('model.', "
+                "'perceiver.', '') — keys look like: "
                 f"{sorted(sd)[:8]}")
     sd = {k[len(prefix):]: v for k, v in sd.items()
           if k.startswith(prefix)}
+    if ("0.latent") in sd:
+        # PerceiverIO-as-Sequential child names → named-attribute form
+        def _norm(k):
+            if k.startswith("0."):
+                return "encoder." + k[2:]
+            if k.startswith("1."):
+                return "decoder." + k[2:]
+            return k
+        sd = {_norm(k): v for k, v in sd.items()}
     # loud-failure contract: trained weights outside the encoder/
     # decoder subtrees (there are none in any reference model — masking
     # and the metrics have no params) must not vanish silently
@@ -302,3 +321,104 @@ def restore_from_torch(path: str, template: Optional[dict] = None,
     if template is not None:
         assert_tree_matches(params, template)
     return params
+
+
+# --- export (the reverse direction) ---------------------------------------
+
+def _unstack(tree):
+    """Inverse of the self-block stacking: stacked leaves (layer axis
+    0) → list of per-layer trees."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    n = leaves[0].shape[0]
+    return [jax.tree.map(lambda x, i=i: np.asarray(x[i]), tree)
+            for i in range(n)]
+
+
+def _export_mha(mha: dict, out: Dict[str, np.ndarray], prefix: str):
+    qw, kw, vw = (_t(mha[k]["w"]) for k in ("q", "k", "v"))
+    e = qw.shape[0]
+    if kw.shape[1] == e and vw.shape[1] == e:
+        # torch packs q/k/v when all widths agree
+        out[prefix + "in_proj_weight"] = np.concatenate([qw, kw, vw])
+    else:
+        out[prefix + "q_proj_weight"] = qw
+        out[prefix + "k_proj_weight"] = kw
+        out[prefix + "v_proj_weight"] = vw
+    out[prefix + "in_proj_bias"] = np.concatenate(
+        [_a(mha[k]["b"]) for k in ("q", "k", "v")])
+    out[prefix + "out_proj.weight"] = _t(mha["out"]["w"])
+    out[prefix + "out_proj.bias"] = _a(mha["out"]["b"])
+
+
+def _export_mlp(mlp: dict, out: Dict[str, np.ndarray], prefix: str):
+    out[prefix + "0.weight"] = _a(mlp["norm"]["scale"])
+    out[prefix + "0.bias"] = _a(mlp["norm"]["bias"])
+    out[prefix + "1.weight"] = _t(mlp["fc1"]["w"])
+    out[prefix + "1.bias"] = _a(mlp["fc1"]["b"])
+    out[prefix + "3.weight"] = _t(mlp["fc2"]["w"])
+    out[prefix + "3.bias"] = _a(mlp["fc2"]["b"])
+
+
+def _export_cross(cross: dict, out: Dict[str, np.ndarray], prefix: str):
+    attn = cross["attn"]
+    out[prefix + "0.module.q_norm.weight"] = _a(attn["norm_q"]["scale"])
+    out[prefix + "0.module.q_norm.bias"] = _a(attn["norm_q"]["bias"])
+    out[prefix + "0.module.kv_norm.weight"] = _a(attn["norm_kv"]["scale"])
+    out[prefix + "0.module.kv_norm.bias"] = _a(attn["norm_kv"]["bias"])
+    _export_mha(attn["mha"], out, prefix + "0.module.attention.attention.")
+    _export_mlp(cross["mlp"], out, prefix + "1.module.")
+
+
+def export_perceiver_params(params: dict, prefix: str = "model.",
+                            sequential: bool = False,
+                            position_encoding=None
+                            ) -> Dict[str, np.ndarray]:
+    """The reverse migration: this framework's parameter pytree → a
+    reference-format torch ``state_dict`` (numpy leaves; pass through
+    ``torch.as_tensor`` to save). ``convert_perceiver_params`` of the
+    result round-trips to the identical pytree.
+
+    ``sequential=True`` emits the ``0.``/``1.`` child names of the
+    reference's Sequential-based ``PerceiverIO`` (the classifier and
+    ``run.py`` model layout, ``model.py:321-325``); the default named
+    form matches ``PerceiverMLM``. For image models pass
+    ``position_encoding`` (e.g. ``ImageInputAdapter.position_encoding()``)
+    so the reference's persistent Fourier buffer
+    (``adapter.py:43-51``) is present and ``load_state_dict`` works
+    with ``strict=True``; without it, load with ``strict=False`` (the
+    reference recomputes the buffer at construction)."""
+    e_name, d_name = ("0", "1") if sequential else ("encoder", "decoder")
+    out: Dict[str, np.ndarray] = {}
+    enc = params["encoder"]
+    ia = enc.get("input_adapter") or {}
+    if "embed" in ia:
+        out[f"{prefix}{e_name}.input_adapter.text_embedding.weight"] = \
+            _a(ia["embed"])
+        out[f"{prefix}{e_name}.input_adapter.pos_encoding"] = _a(ia["pos"])
+    if position_encoding is not None:
+        out[f"{prefix}{e_name}.input_adapter.position_encoding"] = \
+            _a(position_encoding)
+    out[f"{prefix}{e_name}.latent"] = _a(enc["latent"])
+    for layer in ("layer_1", "layer_n"):
+        if layer not in enc:
+            continue
+        lp = f"{prefix}{e_name}.{layer}."
+        _export_cross(enc[layer]["cross"], out, lp + "0.")
+        for i, self_layer in enumerate(_unstack(enc[layer]["selfs"])):
+            sp = f"{lp}1.{i}."
+            attn = self_layer["attn"]
+            out[sp + "0.module.norm.weight"] = _a(attn["norm"]["scale"])
+            out[sp + "0.module.norm.bias"] = _a(attn["norm"]["bias"])
+            _export_mha(attn["mha"], out,
+                        sp + "0.module.attention.attention.")
+            _export_mlp(self_layer["mlp"], out, sp + "1.module.")
+    dec = params["decoder"]
+    out[f"{prefix}{d_name}.output"] = _a(dec["query"])
+    _export_cross(dec["cross"], out, f"{prefix}{d_name}.cross_attention.")
+    out[f"{prefix}{d_name}.output_adapter.linear.weight"] = \
+        _t(dec["output_adapter"]["linear"]["w"])
+    out[f"{prefix}{d_name}.output_adapter.linear.bias"] = \
+        _a(dec["output_adapter"]["linear"]["b"])
+    return out

@@ -288,24 +288,26 @@ def test_image_checkpoint_import(tmp_path):
     template = task.build().init(jax.random.key(0))
 
     torch.manual_seed(7)
-    sd = {"model.encoder.input_adapter.position_encoding":
+    # REAL classifier layout: PerceiverIO subclasses nn.Sequential
+    # (model.py:321-325), so encoder/decoder serialize as 0./1.
+    sd = {"model.0.input_adapter.position_encoding":
           _np(torch.randn(shape[0], shape[1], c_in - shape[-1])),
-          "model.encoder.latent": _np(torch.randn(n, d))}
+          "model.0.latent": _np(torch.randn(n, d))}
     layers = ["layer_1"] + (["layer_n"] if n_layers > 1 else [])
     for li, layer in enumerate(layers):
         cross_sd, _ = _residual_cross_layer_sd(d, c_in, h, 400 + li)
         for k, val in cross_sd.items():
-            sd[f"model.encoder.{layer}.0.{k}"] = val
+            sd[f"model.0.{layer}.0.{k}"] = val
         for i in range(n_self):
             for k, val in _self_layer_sd(d, h, 500 + 10 * li + i).items():
-                sd[f"model.encoder.{layer}.1.{i}.{k}"] = val
-    sd["model.decoder.output"] = _np(torch.randn(1, d))
+                sd[f"model.0.{layer}.1.{i}.{k}"] = val
+    sd["model.1.output"] = _np(torch.randn(1, d))
     dec_sd, _ = _residual_cross_layer_sd(d, d, h, 600)
     for k, val in dec_sd.items():
-        sd[f"model.decoder.cross_attention.{k}"] = val
+        sd[f"model.1.cross_attention.{k}"] = val
     out = torch.nn.Linear(d, 5)
-    sd["model.decoder.output_adapter.linear.weight"] = _np(out.weight)
-    sd["model.decoder.output_adapter.linear.bias"] = _np(out.bias)
+    sd["model.1.output_adapter.linear.weight"] = _np(out.weight)
+    sd["model.1.output_adapter.linear.bias"] = _np(out.bias)
 
     path = tmp_path / "img.ckpt"
     torch.save({"state_dict": _tensors(sd)}, str(path))
@@ -326,8 +328,13 @@ def test_runpy_style_prefix_autodetect(tmp_path):
     them."""
     v, l, n, d, h, n_self, n_layers = 20, 6, 4, 16, 4, 2, 2
     sd = _full_mlm_state_dict(v, l, n, d, d, h, n_self, n_layers)
-    runpy_sd = {"perceiver." + k[len("model."):]: torch.as_tensor(val)
-                for k, val in sd.items()}
+    def _seq(k):
+        k = k[len("model."):]
+        for name, idx in (("encoder.", "0."), ("decoder.", "1.")):
+            if k.startswith(name):
+                return "perceiver." + idx + k[len(name):]
+        return "perceiver." + k
+    runpy_sd = {_seq(k): torch.as_tensor(val) for k, val in sd.items()}
     path = tmp_path / "runpy.ckpt"
     torch.save({"epoch": 3, "model_state_dict": runpy_sd,
                 "optimizer_state_dict": {}}, str(path))
@@ -336,3 +343,39 @@ def test_runpy_style_prefix_autodetect(tmp_path):
     want = convert_perceiver_params(sd)
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(
         np.asarray(a), np.asarray(b)), params, want)
+
+
+def test_export_roundtrip_and_torch_loadable():
+    """Export (our pytree → reference state dict) round-trips through
+    the importer bit-exactly, and the exported MHA slice strict-loads
+    into a real ``nn.MultiheadAttention``."""
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+    from perceiver_tpu.utils.torch_import import export_perceiver_params
+
+    task = MaskedLanguageModelTask(
+        vocab_size=30, max_seq_len=8, num_latents=4, num_latent_channels=16,
+        num_encoder_layers=2, num_encoder_cross_attention_heads=4,
+        num_encoder_self_attention_heads=4,
+        num_decoder_cross_attention_heads=4,
+        num_encoder_self_attention_layers_per_block=2)
+    params = jax.tree.map(np.asarray, task.build().init(jax.random.key(3)))
+
+    sd = export_perceiver_params(params)
+    back = convert_perceiver_params(sd)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), back, params)
+
+    # torch accepts the exported attention layout verbatim
+    mha = torch.nn.MultiheadAttention(embed_dim=16, num_heads=4,
+                                      batch_first=True)
+    pre = "model.encoder.layer_1.0.0.module.attention.attention."
+    slice_sd = {k[len(pre):]: torch.as_tensor(v) for k, v in sd.items()
+                if k.startswith(pre)}
+    mha.load_state_dict(slice_sd, strict=True)
+
+    # sequential (classifier/run.py) child naming also round-trips
+    seq_sd = export_perceiver_params(params, sequential=True)
+    assert "model.0.latent" in seq_sd and "model.1.output" in seq_sd
+    back_seq = convert_perceiver_params(seq_sd)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), back_seq, params)
