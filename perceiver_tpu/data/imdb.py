@@ -23,8 +23,10 @@ TPU deviations (deliberate):
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
+import uuid
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -39,6 +41,14 @@ from perceiver_tpu.tokenizer import (
     train_tokenizer,
 )
 from perceiver_tpu.tokenizer.wordpiece import Replace
+
+
+def _file_sha1(path: str) -> str:
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 class Collator:
@@ -242,12 +252,46 @@ class IMDBDataModule:
         self.tokenizer = load_tokenizer(tok_path)
         self.collator = Collator(self.tokenizer, self.max_seq_len)
 
+        # tokenized-array cache: re-tokenizing the full corpus costs
+        # minutes of single-core host time per process start (paid on
+        # every resume of a long run); the arrays are cheap to store.
+        # Keyed by the tokenizer file's digest + seq_len so a corpus
+        # retrain or config change invalidates it.
+        cache = (tok_path.replace(".json", f"-ids-L{self.max_seq_len}.npz")
+                 if have_corpus else None)
+        tok_sha = _file_sha1(tok_path) if cache else None
+        if cache and os.path.exists(cache):
+            try:
+                with np.load(cache, allow_pickle=False) as z:
+                    if str(z["tokenizer_sha"]) == tok_sha:
+                        self._train = ArrayDataset(
+                            label=z["tr_y"], input_ids=z["tr_ids"],
+                            pad_mask=z["tr_pad"])
+                        self._test = ArrayDataset(
+                            label=z["te_y"], input_ids=z["te_ids"],
+                            pad_mask=z["te_pad"])
+                        return
+            except Exception:  # noqa: BLE001 — fall through and rebuild
+                pass
+
         tr_texts, tr_labels = self._raw_train(have_corpus)
         te_texts, te_labels = self._raw_test(have_corpus)
         y, ids, pad = self.collator.collate(tr_labels, tr_texts)
         self._train = ArrayDataset(label=y, input_ids=ids, pad_mask=pad)
         y, ids, pad = self.collator.collate(te_labels, te_texts)
         self._test = ArrayDataset(label=y, input_ids=ids, pad_mask=pad)
+        if cache:
+            # atomic publish; the temp name must be unique across
+            # processes AND hosts (containerized hosts sharing a cache
+            # filesystem can collide on pid alone)
+            tmp = f"{cache}.{uuid.uuid4().hex}.tmp.npz"
+            tr, te = self._train.fields, self._test.fields
+            np.savez(tmp, tokenizer_sha=tok_sha,
+                     tr_y=tr["label"], tr_ids=tr["input_ids"],
+                     tr_pad=tr["pad_mask"],
+                     te_y=te["label"], te_ids=te["input_ids"],
+                     te_pad=te["pad_mask"])
+            os.replace(tmp, cache)
 
     def train_dataloader(self) -> BatchIterator:
         self.setup()

@@ -344,3 +344,60 @@ def test_preemption_checkpoint_and_resume(tmp_path):
     trainer2._preempted = True
     state2 = trainer2.fit()
     assert int(state2.step) == int(trainer.global_step) + 2
+
+
+def test_imdb_tokenized_array_cache(tmp_path):
+    """setup() caches tokenized arrays (real-corpus runs only) and
+    invalidates on tokenizer change."""
+    import glob as _glob
+
+    root = tmp_path / "cache"
+    for split in ("train", "test"):
+        for label in ("neg", "pos"):
+            d = root / "aclImdb" / split / label
+            d.mkdir(parents=True)
+            for i in range(3):
+                (d / f"{i}_7.txt").write_text(
+                    f"{label} review number {i} with some words to "
+                    f"tokenize and cache for the {split} split")
+
+    dm = IMDBDataModule(data_dir=str(root), vocab_size=120, max_seq_len=32)
+    dm.prepare_data()
+    dm.setup()
+    npz = _glob.glob(str(root / "*-ids-L32.npz"))
+    assert len(npz) == 1, npz
+    want = dm._train.fields["input_ids"].copy()
+
+    # plant a sentinel in the cached arrays: a warm setup must SERVE
+    # the cache (a silent re-tokenize would also equal `want` and hide
+    # a dead cache path)
+    with np.load(npz[0], allow_pickle=False) as z:
+        planted = {k: z[k].copy() for k in z.files}
+    planted["tr_ids"] = planted["tr_ids"].copy()
+    planted["tr_ids"][0, 0] = 119
+    np.savez(npz[0], **planted)
+    dm2 = IMDBDataModule(data_dir=str(root), vocab_size=120,
+                         max_seq_len=32)
+    dm2.setup()
+    assert dm2._train.fields["input_ids"][0, 0] == 119  # cache HIT
+
+    # corrupt cache → silently rebuilt (sentinel gone), not crashed
+    with open(npz[0], "wb") as f:
+        f.write(b"not an npz")
+    dm3 = IMDBDataModule(data_dir=str(root), vocab_size=120,
+                         max_seq_len=32)
+    dm3.setup()
+    np.testing.assert_array_equal(dm3._train.fields["input_ids"], want)
+
+    # re-plant, then change the tokenizer file: the digest mismatch
+    # must invalidate the cache (rebuilt arrays, sentinel gone)
+    np.savez(npz[0], **planted)
+    tok_path = dm._tokenizer_path_for(True)
+    with open(tok_path) as f:
+        content = f.read()
+    with open(tok_path, "w") as f:
+        f.write(content + "\n")
+    dm4 = IMDBDataModule(data_dir=str(root), vocab_size=120,
+                         max_seq_len=32)
+    dm4.setup()
+    np.testing.assert_array_equal(dm4._train.fields["input_ids"], want)
