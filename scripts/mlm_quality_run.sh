@@ -13,12 +13,38 @@ MAX_STEPS=${1:-50000}
 shift || true
 
 EXP=mlm_tpu_quality
+# The CPU hedge run (same corpus/config) would fight this run for the
+# single host core; stop it — its progress carries over via the
+# furthest-step checkpoint selection below. SIGTERM triggers its
+# preemption save, which can take a while on a loaded host: wait for
+# the process to actually exit so the save is complete, not racing.
+if pgrep -f "scripts/mlm.py.*mlm_cpu_quality" > /dev/null 2>&1; then
+  pkill -f "scripts/mlm.py.*mlm_cpu_quality"
+  for _ in $(seq 1 90); do
+    pgrep -f "scripts/mlm.py.*mlm_cpu_quality" > /dev/null 2>&1 || break
+    sleep 2
+  done
+fi
+
+# Resume from the checkpoint dir holding the FURTHEST committed step
+# (numeric orbax step subdirs), across this experiment's versions
+# (regular + preempt saves) and the CPU hedge's. Mtime would lie: a
+# fresh dir holds only hparams.json before the first save, and the
+# slow CPU hedge saves more recently than a further-along TPU run.
 RESUME=()
-# newest checkpoint across versions (regular or preempt saves)
-latest=$(ls -dt logs/$EXP/version_*/checkpoints* 2>/dev/null | head -1)
-if [[ -n "${latest:-}" ]]; then
-  RESUME=(--trainer.resume_from_checkpoint "$latest")
-  echo "resuming from $latest"
+best_dir=""; best_step=-1
+for d in logs/$EXP/version_*/checkpoints* \
+         logs/mlm_cpu_quality/version_*/checkpoints*; do
+  [[ -d "$d" ]] || continue
+  for s in "$d"/*/; do
+    s=${s%/}; s=${s##*/}
+    [[ "$s" =~ ^[0-9]+$ ]] || continue
+    if (( s > best_step )); then best_step=$s; best_dir=$d; fi
+  done
+done
+if [[ -n "$best_dir" ]]; then
+  RESUME=(--trainer.resume_from_checkpoint "$best_dir")
+  echo "resuming from $best_dir (step $best_step)"
 fi
 
 exec python scripts/mlm.py fit \
