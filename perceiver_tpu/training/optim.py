@@ -66,12 +66,26 @@ def build_schedule(scheduler_init: Optional[dict],
     """
     if scheduler_init is None:
         return base_lr
+    scheduler_init = dict(scheduler_init)
+    # "defaulted": the scheduler was injected by a script's defaults
+    # (e.g. mlm.py's always-on OneCycleLR, reference mlm.py:14-16) —
+    # an unresolvable schedule then degrades to constant lr with a
+    # warning instead of failing invocations that never asked for it
+    defaulted = scheduler_init.pop("defaulted", False)
     name = _cls_name(scheduler_init.get("class_path", ""))
     _check_keys(scheduler_init, "lr_scheduler", name)
     args = dict(scheduler_init.get("init_args", {}))
     if name == "OneCycleLR":
         total = args.get("total_steps") or max_steps
         if not total or total <= 0:
+            if defaulted:
+                import warnings
+
+                warnings.warn(
+                    "OneCycleLR (the default MLM schedule) needs "
+                    "total_steps or trainer.max_steps; training at "
+                    "constant lr instead", stacklevel=2)
+                return base_lr
             raise ValueError(
                 "OneCycleLR needs total_steps (or trainer max_steps)")
         return optax.cosine_onecycle_schedule(

@@ -82,6 +82,10 @@ class Link:
     target: str
     apply_on: str = "parse"  # "parse" | "instantiate"
     compute_fn: Optional[Callable[[Any], Any]] = None
+    # optional gate: the link applies only when this predicate of the
+    # merged config holds (e.g. OneCycle-specific links must not inject
+    # total_steps/max_lr into a different scheduler class)
+    when: Optional[Callable[[dict], bool]] = None
 
 
 class CLI:
@@ -174,11 +178,26 @@ class CLI:
         # links equally
         config = _deep_merge(config, explicit)
 
+        # 'defaulted' is an internal marker a script's defaults attach
+        # to a scheduler it injects (mlm.py's always-on OneCycleLR):
+        # resolved here — CLI is the only layer that knows explicit
+        # from default — and never exposed to users or the snapshot
+        sched = config.get("lr_scheduler")
+        self._sched_defaulted = False
+        if isinstance(sched, dict) and "defaulted" in sched:
+            if "defaulted" in (explicit.get("lr_scheduler") or {}):
+                raise SystemExit(
+                    "--lr_scheduler.defaulted is not a user flag")
+            self._sched_defaulted = (bool(sched.pop("defaulted"))
+                                     and "lr_scheduler" not in explicit)
+
         # static (parse-time) links — a link only fills values into a
         # group the user actually configured (linking OneCycle args into
         # an absent lr_scheduler would fabricate a broken scheduler)
         for link in self.links:
             if link.apply_on != "parse":
+                continue
+            if link.when is not None and not link.when(config):
                 continue
             target_root = link.target.split(".")[0]
             if target_root not in config:
@@ -250,10 +269,24 @@ class CLI:
             raise SystemExit(f"Unknown --trainer args: {sorted(t_unknown)}")
         tcfg = TrainerConfig(**trainer_cfg)
 
+        scheduler_init = self.config.get("lr_scheduler")
+        if scheduler_init is not None and \
+                getattr(self, "_sched_defaulted", False):
+            if self.subcommand == "fit":
+                # optim degrades an unresolvable defaulted schedule to
+                # constant lr with a warning instead of failing a run
+                # that never asked for a scheduler
+                scheduler_init = {**scheduler_init, "defaulted": True}
+            else:
+                # validate/test/predict never step the optimizer — a
+                # default-injected schedule (and its possible warning)
+                # has no business there
+                scheduler_init = None
+
         trainer = Trainer(
             task, datamodule, tcfg,
             optimizer_init=self.config.get("optimizer"),
-            scheduler_init=self.config.get("lr_scheduler"),
+            scheduler_init=scheduler_init,
             mesh=self._build_mesh(trainer_cfg))
         return task, datamodule, trainer
 

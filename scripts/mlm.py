@@ -27,6 +27,13 @@ DEFAULT_MASKED_SAMPLES = [
 ]
 
 
+def _is_onecycle(config: dict) -> bool:
+    sched = config.get("lr_scheduler")
+    return (isinstance(sched, dict)
+            and str(sched.get("class_path", "")).rsplit(".", 1)[-1]
+            == "OneCycleLR")
+
+
 def main(args=None, run=True):
     return CLI(
         MaskedLanguageModelTask,
@@ -37,13 +44,24 @@ def main(args=None, run=True):
             "experiment": "mlm",
             "model.masked_samples": DEFAULT_MASKED_SAMPLES,
             "model.num_predictions": 3,
+            # the reference MLM CLI always trains under OneCycleLR
+            # (mlm.py:14-16 registers it unconditionally); the links
+            # below fill total_steps/max_lr. "defaulted" lets optim
+            # fall back to constant lr when max_steps is unset, where
+            # the reference would crash.
+            "lr_scheduler.class_path": "OneCycleLR",
+            "lr_scheduler.defaulted": True,
         },
         links=[
             # reference mlm.py:14-18: OneCycle total_steps ← max_steps,
-            # max_lr ← optimizer lr; model vocab/seq ← datamodule
+            # max_lr ← optimizer lr; model vocab/seq ← datamodule.
+            # Gated on the scheduler actually being OneCycleLR — the
+            # user may switch class, and these args are OneCycle's
             Link("trainer.max_steps",
-                 "lr_scheduler.init_args.total_steps"),
-            Link("optimizer.init_args.lr", "lr_scheduler.init_args.max_lr"),
+                 "lr_scheduler.init_args.total_steps",
+                 when=_is_onecycle),
+            Link("optimizer.init_args.lr", "lr_scheduler.init_args.max_lr",
+                 when=_is_onecycle),
             Link("data.vocab_size", "model.vocab_size",
                  apply_on="instantiate"),
             Link("data.max_seq_len", "model.max_seq_len",

@@ -93,3 +93,66 @@ def test_typod_init_args_keys_rejected():
             SGD, scheduler_init={"class_path": "OneCycleLR",
                                  "init_args": {"total_step": 5000}},
             max_steps=10)
+
+
+def test_defaulted_onecycle_falls_back_without_total_steps():
+    """The MLM CLI injects OneCycleLR by default (reference mlm.py:14-16
+    registers it unconditionally); with no max_steps the defaulted
+    schedule degrades to constant lr with a warning instead of failing
+    invocations that never asked for a scheduler."""
+    import warnings
+
+    import pytest
+
+    from perceiver_tpu.training.optim import build_schedule
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        sched = build_schedule(
+            {"class_path": "OneCycleLR", "defaulted": True},
+            base_lr=0.002, max_steps=None)
+    assert sched == 0.002
+    assert any("constant lr" in str(x.message) for x in w)
+
+    # explicit (non-defaulted) OneCycle without steps still fails loudly
+    with pytest.raises(ValueError, match="total_steps"):
+        build_schedule({"class_path": "OneCycleLR"}, base_lr=0.002,
+                       max_steps=None)
+
+    # with steps, the defaulted schedule is a real OneCycle
+    sched = build_schedule(
+        {"class_path": "OneCycleLR", "defaulted": True},
+        base_lr=0.002, max_steps=1000)
+    assert callable(sched)
+    assert float(sched(0)) < 0.0005 < 0.002  # warmup start << max_lr
+
+
+def test_mlm_cli_defaults_onecycle():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import mlm as mlm_script
+
+    cli = mlm_script.main(args=["fit"], run=False)
+    sched = cli.config.get("lr_scheduler")
+    assert sched and sched["class_path"] == "OneCycleLR"
+    # the marker is internal: resolved by the CLI, never in the
+    # user-visible config (it would otherwise leak into the run's
+    # config.yaml snapshot and become a de-facto user flag)
+    assert "defaulted" not in sched
+    assert cli._sched_defaulted is True
+
+    # an explicit user scheduler clears defaultedness (fail-loudly
+    # semantics for explicitly requested OneCycle are preserved)
+    cli2 = mlm_script.main(
+        args=["fit", "--lr_scheduler.class_path=OneCycleLR"], run=False)
+    assert cli2._sched_defaulted is False
+
+    # switching scheduler class must not inherit OneCycle-only links
+    cli3 = mlm_script.main(
+        args=["fit", "--lr_scheduler.class_path=CosineAnnealingLR",
+              "--lr_scheduler.init_args.T_max=100"], run=False)
+    ia = cli3.config["lr_scheduler"].get("init_args", {})
+    assert "total_steps" not in ia and "max_lr" not in ia
