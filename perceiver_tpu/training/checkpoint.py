@@ -68,6 +68,25 @@ class CheckpointHook:
             return None
         return self.restore(step, template_state)
 
+    def restore_params_and_step(self, template_state: TrainState
+                                ) -> Optional[TrainState]:
+        """Partial resume for a checkpoint whose optimizer state no
+        longer matches the current optimizer/scheduler config (e.g.
+        the schedule was changed between runs): restore params + rng +
+        step, keep the template's freshly initialized opt_state."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        got = _partial_restore(
+            os.path.join(self.directory, str(step), "default"),
+            {"params": template_state.params,
+             "rng": jax.random.key_data(template_state.rng),
+             "step": template_state.step})
+        return TrainState(params=got["params"],
+                          opt_state=template_state.opt_state,
+                          rng=jax.random.wrap_key_data(got["rng"]),
+                          step=got["step"])
+
     def restore(self, step: int, template_state: TrainState) -> TrainState:
         template = {
             "params": template_state.params,
@@ -101,6 +120,18 @@ def save_params(path: str, params: Any, hparams: Optional[dict] = None):
             json.dump(hparams, f, indent=2, default=str)
 
 
+def _partial_restore(path: str, item: dict) -> dict:
+    """Typed partial restore of selected subtrees from a checkpoint
+    step's ``default`` item dir (a save may hold more than the caller
+    wants — or can type — e.g. an opt_state from a different optimizer
+    config)."""
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+            item=item,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(item),
+            partial_restore=True))
+
+
 def restore_params(path: str, template: Any = None) -> Any:
     """Load a params pytree from either a ``save_params`` directory or a
     ``CheckpointHook`` step directory (transfer-learning source,
@@ -123,13 +154,7 @@ def restore_params(path: str, template: Any = None) -> Any:
         if template is not None and wrapped:
             # hook layout stores {params, opt_state, rng, step}; only
             # params is wanted (and only its template is available)
-            item = {"params": template}
-            with ocp.PyTreeCheckpointer() as ckptr:
-                got = ckptr.restore(c, args=ocp.args.PyTreeRestore(
-                    item=item,
-                    restore_args=ocp.checkpoint_utils
-                    .construct_restore_args(item),
-                    partial_restore=True))
+            got = _partial_restore(c, {"params": template})
         else:
             with ocp.StandardCheckpointer() as ckptr:
                 got = ckptr.restore(c, template)

@@ -57,21 +57,21 @@ def _check_keys(init: dict, group: str, name: str):
 
 def build_schedule(scheduler_init: Optional[dict],
                    base_lr: float,
-                   max_steps: Optional[int] = None):
+                   max_steps: Optional[int] = None,
+                   defaulted: bool = False):
     """LR schedule from a scheduler_init dict; constant if None.
 
     OneCycleLR maps onto ``optax.cosine_onecycle_schedule`` — identical
     math to torch's cosine-annealed OneCycle (default pct_start 0.3,
     div_factor 25, final_div_factor 1e4).
+
+    ``defaulted=True`` marks a scheduler injected by a script's
+    defaults (mlm.py's always-on OneCycleLR, reference mlm.py:14-16):
+    an unresolvable schedule then degrades to constant lr with a
+    warning instead of failing invocations that never asked for it.
     """
     if scheduler_init is None:
         return base_lr
-    scheduler_init = dict(scheduler_init)
-    # "defaulted": the scheduler was injected by a script's defaults
-    # (e.g. mlm.py's always-on OneCycleLR, reference mlm.py:14-16) —
-    # an unresolvable schedule then degrades to constant lr with a
-    # warning instead of failing invocations that never asked for it
-    defaulted = scheduler_init.pop("defaulted", False)
     name = _cls_name(scheduler_init.get("class_path", ""))
     _check_keys(scheduler_init, "lr_scheduler", name)
     args = dict(scheduler_init.get("init_args", {}))
@@ -113,6 +113,7 @@ def create_optimizer(
         gradient_clip_val: float = 0.0,
         accumulate_grad_batches: int = 1,
         param_labels=None,
+        scheduler_defaulted: bool = False,
 ) -> Tuple[optax.GradientTransformation, Callable[[int], float]]:
     """Returns ``(tx, lr_fn)``; ``lr_fn(step)`` is for LR logging (the
     reference's LearningRateMonitor, ``trainer.yaml:6-9``).
@@ -127,7 +128,8 @@ def create_optimizer(
     _check_keys(optimizer_init, "optimizer", name)
     args = dict(optimizer_init.get("init_args", {}))
     lr = args.get("lr", args.get("learning_rate", 1e-3))
-    schedule = build_schedule(scheduler_init, lr, max_steps)
+    schedule = build_schedule(scheduler_init, lr, max_steps,
+                              defaulted=scheduler_defaulted)
 
     betas = tuple(args.get("betas", (0.9, 0.999)))
     if name == "AdamW":

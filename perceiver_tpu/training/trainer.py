@@ -180,13 +180,22 @@ class Trainer:
     def __init__(self, task, datamodule, config: TrainerConfig = None,
                  optimizer_init: Optional[dict] = None,
                  scheduler_init: Optional[dict] = None,
+                 scheduler_defaulted: bool = False,
                  mesh: Optional[jax.sharding.Mesh] = None):
         self.task = task
         self.datamodule = datamodule
         self.config = config or TrainerConfig()
         self.optimizer_init = optimizer_init
         self.scheduler_init = scheduler_init
+        # True when the scheduler came from a script's defaults, not
+        # the user (CLI-resolved): an unresolvable schedule then
+        # degrades to constant lr instead of failing the run
+        self.scheduler_defaulted = scheduler_defaulted
         self.mesh = mesh
+        # schedule restart offset for the partial-resume fallback (the
+        # fresh optimizer's schedule count restarts at 0 while
+        # global_step resumes): logged lr must match the applied lr
+        self._lr_step_offset = 0
 
         apply_accelerator(self.config.accelerator)
 
@@ -238,7 +247,8 @@ class Trainer:
             max_steps=cfg.max_steps if cfg.max_steps > 0 else None,
             gradient_clip_val=cfg.gradient_clip_val,
             accumulate_grad_batches=cfg.accumulate_grad_batches,
-            param_labels=labels)
+            param_labels=labels,
+            scheduler_defaulted=self.scheduler_defaulted)
         opt_state = self.tx.init(params)
         state = TrainState.create(params, opt_state, state_rng)
 
@@ -478,7 +488,32 @@ class Trainer:
         if cfg.resume_from_checkpoint:
             hook = CheckpointHook(cfg.resume_from_checkpoint,
                                   monitor=cfg.checkpoint_monitor)
-            restored = hook.restore_latest(state)
+            try:
+                restored = hook.restore_latest(state)
+            except ValueError as e:
+                # orbax raises ValueError on tree/shape mismatch —
+                # typically the checkpoint's optimizer state no longer
+                # matching the current optimizer/scheduler config
+                # (e.g. the schedule changed between runs); params +
+                # rng + step are still config-agnostic and worth
+                # resuming from. Other failures (I/O, corruption)
+                # propagate.
+                import warnings
+
+                warnings.warn(
+                    f"full-state resume from "
+                    f"{cfg.resume_from_checkpoint} failed "
+                    f"({type(e).__name__}) — the checkpoint's "
+                    f"optimizer state is incompatible with the current "
+                    f"optimizer/scheduler config; restoring "
+                    f"params/rng/step with a FRESH optimizer state "
+                    f"instead (momentum and schedule restart)",
+                    stacklevel=2)
+                restored = hook.restore_params_and_step(state)
+                if restored is not None:
+                    # the fresh schedule counts from 0 while
+                    # global_step resumes — keep the logged lr honest
+                    self._lr_step_offset = int(restored.step)
             if restored is not None:
                 state = restored
                 self.global_step = int(state.step)
@@ -619,7 +654,8 @@ class Trainer:
                                                self.global_step)
                     # MultiSteps advances the schedule once per
                     # accumulation window, not per micro-step
-                    opt_step = (self.global_step
+                    opt_step = (max(self.global_step
+                                    - self._lr_step_offset, 0)
                                 // max(cfg.accumulate_grad_batches, 1))
                     self.writer.add_scalar(
                         "lr", float(self.lr_fn(opt_step)),

@@ -129,6 +129,16 @@ class CLI:
             for k, v in defaults.items():
                 _set_dotted(flat, k, v)
             config = _deep_merge(config, flat)
+        # 'defaulted' marks a scheduler a script's DEFAULTS inject
+        # (mlm.py's always-on OneCycleLR): consumed here, before the
+        # user's explicit config merges — a user-supplied 'defaulted'
+        # key survives into the optimizer factory, which rejects it as
+        # unknown. The resolved flag travels out-of-band (a Trainer
+        # argument), never through config, so snapshots and the
+        # checkpoint hparams stay clean.
+        sched_defaulted = bool(
+            isinstance(config.get("lr_scheduler"), dict)
+            and config["lr_scheduler"].pop("defaulted", False))
 
         # --config file contents and dotted flags merge last-wins in
         # argv order (reference LightningCLI/jsonargparse semantics:
@@ -178,18 +188,10 @@ class CLI:
         # links equally
         config = _deep_merge(config, explicit)
 
-        # 'defaulted' is an internal marker a script's defaults attach
-        # to a scheduler it injects (mlm.py's always-on OneCycleLR):
-        # resolved here — CLI is the only layer that knows explicit
-        # from default — and never exposed to users or the snapshot
-        sched = config.get("lr_scheduler")
-        self._sched_defaulted = False
-        if isinstance(sched, dict) and "defaulted" in sched:
-            if "defaulted" in (explicit.get("lr_scheduler") or {}):
-                raise SystemExit(
-                    "--lr_scheduler.defaulted is not a user flag")
-            self._sched_defaulted = (bool(sched.pop("defaulted"))
-                                     and "lr_scheduler" not in explicit)
+        # a scheduler counts as defaulted only while the user hasn't
+        # configured the group themselves
+        self._sched_defaulted = (sched_defaulted
+                                 and "lr_scheduler" not in explicit)
 
         # static (parse-time) links — a link only fills values into a
         # group the user actually configured (linking OneCycle args into
@@ -270,23 +272,19 @@ class CLI:
         tcfg = TrainerConfig(**trainer_cfg)
 
         scheduler_init = self.config.get("lr_scheduler")
-        if scheduler_init is not None and \
-                getattr(self, "_sched_defaulted", False):
-            if self.subcommand == "fit":
-                # optim degrades an unresolvable defaulted schedule to
-                # constant lr with a warning instead of failing a run
-                # that never asked for a scheduler
-                scheduler_init = {**scheduler_init, "defaulted": True}
-            else:
-                # validate/test/predict never step the optimizer — a
-                # default-injected schedule (and its possible warning)
-                # has no business there
-                scheduler_init = None
+        sched_defaulted = getattr(self, "_sched_defaulted", False)
+        if scheduler_init is not None and sched_defaulted \
+                and self.subcommand != "fit":
+            # validate/test/predict never step the optimizer — a
+            # default-injected schedule (and its possible warning) has
+            # no business there
+            scheduler_init = None
 
         trainer = Trainer(
             task, datamodule, tcfg,
             optimizer_init=self.config.get("optimizer"),
             scheduler_init=scheduler_init,
+            scheduler_defaulted=sched_defaulted,
             mesh=self._build_mesh(trainer_cfg))
         return task, datamodule, trainer
 

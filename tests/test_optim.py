@@ -108,9 +108,9 @@ def test_defaulted_onecycle_falls_back_without_total_steps():
 
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
-        sched = build_schedule(
-            {"class_path": "OneCycleLR", "defaulted": True},
-            base_lr=0.002, max_steps=None)
+        sched = build_schedule({"class_path": "OneCycleLR"},
+                               base_lr=0.002, max_steps=None,
+                               defaulted=True)
     assert sched == 0.002
     assert any("constant lr" in str(x.message) for x in w)
 
@@ -119,10 +119,15 @@ def test_defaulted_onecycle_falls_back_without_total_steps():
         build_schedule({"class_path": "OneCycleLR"}, base_lr=0.002,
                        max_steps=None)
 
+    # a user-smuggled in-dict marker is rejected as an unknown key
+    with pytest.raises(ValueError, match="unknown lr_scheduler"):
+        build_schedule({"class_path": "OneCycleLR", "defaulted": True},
+                       base_lr=0.002, max_steps=1000)
+
     # with steps, the defaulted schedule is a real OneCycle
-    sched = build_schedule(
-        {"class_path": "OneCycleLR", "defaulted": True},
-        base_lr=0.002, max_steps=1000)
+    sched = build_schedule({"class_path": "OneCycleLR"},
+                           base_lr=0.002, max_steps=1000,
+                           defaulted=True)
     assert callable(sched)
     assert float(sched(0)) < 0.0005 < 0.002  # warmup start << max_lr
 

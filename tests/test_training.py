@@ -1,8 +1,10 @@
 """End-to-end training-slice tests (SURVEY §4 plan items d, e)."""
 
+import dataclasses
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -401,3 +403,40 @@ def test_imdb_tokenized_array_cache(tmp_path):
                          max_seq_len=32)
     dm4.setup()
     np.testing.assert_array_equal(dm4._train.fields["input_ids"], want)
+
+
+def test_resume_falls_back_to_params_when_optimizer_config_changed(tmp_path):
+    """Changing the optimizer/scheduler between runs breaks the typed
+    full-state restore; the resume path must fall back to
+    params/rng/step with a fresh optimizer state (and warn) instead of
+    crashing with an orbax tree-mismatch error."""
+    import optax
+
+    from perceiver_tpu.training.checkpoint import CheckpointHook
+    from perceiver_tpu.training.state import TrainState
+
+    params = {"w": jnp.arange(4.0), "b": jnp.ones((2,))}
+    tx_old = optax.adamw(1e-3)  # constant lr
+    state = TrainState.create(params, tx_old.init(params),
+                              jax.random.key(7))
+    state = dataclasses.replace(state, step=jnp.asarray(123))
+    hook = CheckpointHook(str(tmp_path / "ck"), monitor=None)
+    hook.save(123, state, {})
+    hook.wait()
+
+    # new run: scheduled optimizer — different opt_state pytree
+    tx_new = optax.adamw(optax.cosine_onecycle_schedule(1000, 2e-3))
+    template = TrainState.create(
+        {"w": jnp.zeros(4), "b": jnp.zeros((2,))},
+        tx_new.init(params), jax.random.key(0))
+
+    with pytest.raises(Exception):
+        hook.restore_latest(template)
+
+    got = hook.restore_params_and_step(template)
+    np.testing.assert_array_equal(np.asarray(got.params["w"]),
+                                  np.arange(4.0))
+    assert int(got.step) == 123
+    # fresh optimizer state from the template, not the checkpoint
+    assert jax.tree_util.tree_structure(got.opt_state) == \
+        jax.tree_util.tree_structure(template.opt_state)
