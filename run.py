@@ -107,7 +107,7 @@ def main():
     dataset = load_lartpc(args.files, size=args.size,
                           num_synthetic=args.num_synthetic, seed=args.seed)
     n = len(dataset)
-    print(f"num entries: {n}")
+    print(f"num entries: {n}", flush=True)
     n_val = min(args.val_events, max(1, n // 8)) if args.val_events > 0 \
         else 0
     perm = np.random.default_rng(args.seed).permutation(n)
@@ -195,7 +195,7 @@ def main():
             it, m = pending[-1]
             print(f"iter {it} loss {float(m['loss']):.4f} "
                   f"acc {float(m['acc']):.3f} "
-                  f"({time.perf_counter() - t0:.1f}s)")
+                  f"({time.perf_counter() - t0:.1f}s)", flush=True)
         pending.clear()
 
     for epoch in range(args.epochs):
@@ -218,7 +218,7 @@ def main():
             vlosses.append(float(m["loss"]))
             vaccs.append(float(m["acc"]))
         if vlosses:
-            print(f"validation loss: {np.mean(vlosses):.4f}")
+            print(f"validation loss: {np.mean(vlosses):.4f}", flush=True)
             writer.add_scalar("validation_loss", float(np.mean(vlosses)),
                               total_iter)
             writer.add_scalar("val_acc", float(np.mean(vaccs)), total_iter)

@@ -50,7 +50,7 @@ def main():
         cross_attention_apply,
     )
 
-    print(f"device: {jax.devices()[0]}")
+    print(f"device: {jax.devices()[0]}", flush=True)
     for name in names:
         b, nq, nkv, c, h = _SHAPES[name]
         params = cross_attention_init(jax.random.key(0), c, c, h)
@@ -92,10 +92,10 @@ def main():
                 fb_ms = (time.perf_counter() - t0) / reps * 1e3
                 print(f"{name:9s} (B{b} q{nq} kv{nkv} c{c}) "
                       f"{impl:7s} fwd {f_ms:8.2f} ms   "
-                      f"fwd+bwd {fb_ms:8.2f} ms")
+                      f"fwd+bwd {fb_ms:8.2f} ms", flush=True)
             except Exception as e:  # noqa: BLE001 — report and move on
                 print(f"{name:9s} {impl:7s} FAILED: "
-                      f"{type(e).__name__}: {str(e)[:120]}")
+                      f"{type(e).__name__}: {str(e)[:120]}", flush=True)
 
 
 if __name__ == "__main__":
