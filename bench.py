@@ -19,10 +19,19 @@ MNIST imgs/sec/chip with the ``scripts/img_clf.py`` model config
 
 ``vs_baseline`` is null: the reference publishes no throughput numbers
 (BASELINE.json "published": {}).
+
+For a real-TPU target the bench runs under a SUPERVISOR (``BENCH_WAIT``
+seconds of probe-retry budget, default 7200; ``BENCH_PROBE_INTERVAL``
+between probes, default 120): the axon tunnel's availability windows
+are short and rare, so instead of failing on the first dead probe the
+supervisor keeps execution-probing in a subprocess and launches the
+actual bench the moment a probe matmul completes. ``BENCH_WAIT=0``
+(or ``BENCH_PLATFORM=cpu``) runs the ladder directly.
 """
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -85,6 +94,8 @@ class _Watchdog:
     def _run(self):
         while True:
             time.sleep(5)
+            if self.timeout <= 0:
+                continue  # disabled after start (supervisor mode)
             idle = time.monotonic() - self._last
             if idle > self._allow:
                 print(f"[bench] WATCHDOG: no progress for {idle:.0f}s "
@@ -237,6 +248,10 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
                             if step_flops else None),
             "loss": float(loss),
             "device": str(jax.devices()[0]),
+            # truthful evidence labeling (VERDICT r2 #7): what the
+            # numbers were actually measured on, machine-readable
+            "platform": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", None),
         },
     }
 
@@ -321,7 +336,96 @@ def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
                 "num_output_queries": side * side})
 
 
+# Probe run in a SUBPROCESS: a half-dead tunnel blocks block_until_ready
+# uninterruptibly, but a child process can always be SIGKILLed by the
+# supervisor's timeout. Success requires the matmul to EXECUTE (the
+# 2026-07-31 failure mode initialized + compiled fine, then hung on the
+# first dispatch).
+_PROBE_SRC = """
+import os, jax, jax.numpy as jnp
+want = os.environ.get("BENCH_PLATFORM")
+if want:
+    jax.config.update("jax_platforms", want)
+d = jax.devices()
+assert d[0].platform == "tpu", d
+x = jnp.ones((512, 512), jnp.bfloat16)
+(x @ x).block_until_ready()
+"""
+
+
+def _exec_probe(timeout: float = 90.0) -> bool:
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL, timeout=timeout)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def supervise() -> int:
+    """Bounded wait-retry: probe every BENCH_PROBE_INTERVAL seconds for
+    up to BENCH_WAIT seconds; run the actual bench (as a child process,
+    ``BENCH_WAIT=0``) the moment a probe matmul executes.
+
+    The driver's end-of-round bench is the ONE chance to land a number
+    in the round record, and the axon tunnel's availability windows are
+    short and unpredictable (round 2: one ~1-minute window in ~12 h) —
+    exiting on the first failed probe converts a flaky tunnel into a
+    guaranteed rc≠0. The child keeps its own in-process watchdog, so a
+    tunnel that dies mid-run fails the child in minutes (rc=3) and the
+    supervisor goes back to probing with the remaining budget.
+    """
+    budget = float(os.environ.get("BENCH_WAIT", "7200"))
+    interval = float(os.environ.get("BENCH_PROBE_INTERVAL", "120"))
+    deadline = time.monotonic() + budget
+    attempts = completed_failures = 0
+    # the supervisor never enters jax (probes and children are separate
+    # processes with their own timeouts/watchdogs), so its in-process
+    # watchdog can only misfire — e.g. hard-exiting rc=3 while blocked
+    # in subprocess.call on a healthy long-running child
+    _WATCHDOG.timeout = 0
+    while True:
+        t_probe = time.monotonic()
+        if _exec_probe():
+            attempts += 1
+            _log(f"probe OK — starting bench attempt {attempts}")
+            child_env = dict(os.environ, BENCH_WAIT="0")
+            # child inherits stdout: the JSON line flows to the driver
+            rc = subprocess.call([sys.executable, os.path.abspath(__file__)],
+                                 env=child_env)
+            if rc == 0:
+                return 0
+            _log(f"bench attempt {attempts} failed rc={rc}")
+            # rc=3: child watchdog (tunnel died mid-run); rc=5: child
+            # saw the backend UNAVAILABLE (window closed right after
+            # the probe). Those are transient — keep waiting. Anything
+            # else (incl. -9: the kernel OOM-killing the child at a
+            # fixed ladder config repeats identically every attempt)
+            # counts toward the deterministic-failure cap.
+            if rc not in (3, 5):
+                completed_failures += 1  # failed: likely deterministic
+                if completed_failures >= 2:
+                    _log("two completed-but-failed attempts — giving up "
+                         "(failure looks deterministic, not a tunnel flake)")
+                    return rc
+        else:
+            _log("probe: backend down or dispatch hung")
+        if time.monotonic() >= deadline:
+            _log(f"BENCH_WAIT budget ({budget:.0f}s) exhausted with no "
+                 f"completed bench — backend never yielded a usable window")
+            return 4
+        time.sleep(max(0.0, interval - (time.monotonic() - t_probe)))
+
+
 def main():
+    # Supervisor mode: only for a real-TPU target (BENCH_PLATFORM unset
+    # or tpu) with a nonzero wait budget. CPU smoke runs, sweeps, and
+    # the supervisor's own children (BENCH_WAIT=0) run directly.
+    if (float(os.environ.get("BENCH_WAIT", "7200")) > 0
+            and os.environ.get("BENCH_PLATFORM", "tpu") == "tpu"):
+        raise SystemExit(supervise())
+
     pinned = any(k in os.environ for k in
                  ("BENCH_BATCH", "BENCH_INNER_STEPS", "BENCH_LOSS_IMPL"))
     top_b, top_inner, top_impl = _LADDER[0]
@@ -349,7 +453,13 @@ def main():
                 deduped.append((b, inner, "n/a"))
         configs = deduped
 
-    probe_backend()  # fail fast (and once) if no backend comes up
+    try:
+        probe_backend()  # fail fast (and once) if no backend comes up
+    except Exception as e:  # noqa: BLE001
+        # rc=5 tells a supervising parent this was the tunnel, not the
+        # bench — a transient to wait out, never a deterministic failure
+        _log(f"backend init failed: {type(e).__name__}: {str(e)[:300]}")
+        raise SystemExit(5)
 
     last_err = None
     for i, (b, inner, impl) in enumerate(configs):
@@ -370,8 +480,10 @@ def main():
                  f"failed: {last_err[:220]}")
             if "UNAVAILABLE" in last_err or "Unable to initialize" in last_err:
                 # dead backend, not resource pressure — smaller configs
-                # would hit the same wall after the same long hang
-                raise SystemExit(f"backend unavailable: {last_err}")
+                # would hit the same wall after the same long hang.
+                # rc=5 = transient-tunnel signal to a supervising parent
+                _log(f"backend unavailable: {last_err}")
+                raise SystemExit(5)
     raise SystemExit(f"all bench configs failed; last: {last_err}")
 
 

@@ -51,6 +51,26 @@ def _file_sha1(path: str) -> str:
     return h.hexdigest()
 
 
+def _corpus_fingerprint(root: str) -> str:
+    """Cheap content proxy for the aclImdb tree: doc count + total
+    bytes per split/label dir (one stat scan, ~1 s for 100k docs —
+    hashing the 36+ MB of text every setup() would not be). Detects
+    in-place corpus rewrites that leave the tokenizer json untouched."""
+    parts = []
+    for split in ("train", "test"):
+        for label in ("neg", "pos"):
+            n = total = 0
+            try:
+                with os.scandir(os.path.join(root, split, label)) as it:
+                    for e in it:
+                        n += 1
+                        total += e.stat().st_size
+            except OSError:
+                pass
+            parts.append(f"{n}.{total}")
+    return ":".join(parts)
+
+
 class Collator:
     """Tokenize + truncate + fixed-width pad (reference imdb.py:52-68)."""
 
@@ -255,15 +275,20 @@ class IMDBDataModule:
         # tokenized-array cache: re-tokenizing the full corpus costs
         # minutes of single-core host time per process start (paid on
         # every resume of a long run); the arrays are cheap to store.
-        # Keyed by the tokenizer file's digest + seq_len so a corpus
-        # retrain or config change invalidates it.
+        # Keyed by the tokenizer file's digest + seq_len + a corpus
+        # fingerprint: the tokenizer digest alone misses an in-place
+        # corpus rewrite (harvest_text.py regenerates .cache/aclImdb
+        # without touching the tokenizer json — ADVICE r2), which would
+        # silently serve stale ids AND stale labels.
         cache = (tok_path.replace(".json", f"-ids-L{self.max_seq_len}.npz")
                  if have_corpus else None)
         tok_sha = _file_sha1(tok_path) if cache else None
+        corpus_fp = _corpus_fingerprint(self.aclimdb_root) if cache else None
         if cache and os.path.exists(cache):
             try:
                 with np.load(cache, allow_pickle=False) as z:
-                    if str(z["tokenizer_sha"]) == tok_sha:
+                    if (str(z["tokenizer_sha"]) == tok_sha
+                            and str(z.get("corpus_fp", "")) == corpus_fp):
                         self._train = ArrayDataset(
                             label=z["tr_y"], input_ids=z["tr_ids"],
                             pad_mask=z["tr_pad"])
@@ -286,7 +311,7 @@ class IMDBDataModule:
             # filesystem can collide on pid alone)
             tmp = f"{cache}.{uuid.uuid4().hex}.tmp.npz"
             tr, te = self._train.fields, self._test.fields
-            np.savez(tmp, tokenizer_sha=tok_sha,
+            np.savez(tmp, tokenizer_sha=tok_sha, corpus_fp=corpus_fp,
                      tr_y=tr["label"], tr_ids=tr["input_ids"],
                      tr_pad=tr["pad_mask"],
                      te_y=te["label"], te_ids=te["input_ids"],

@@ -37,14 +37,21 @@ def pack_positions(hidden, labels, weight, capacity: int):
     """Scatter rows with nonzero ``weight`` into a ``capacity``-row buffer.
 
     hidden: (N, C); labels: (N,) int; weight: (N,) fp32 (0 or positive).
-    Returns (hidden_p, labels_p, weight_p) of leading dim ``capacity``.
-    Rows beyond the number of contributing positions have weight 0.
-    Contributing rows past ``capacity`` (overflow) are dropped — size
-    ``capacity`` generously (see module docstring).
+    Returns ``(hidden_p, labels_p, weight_p, overflow)`` where the
+    packed arrays have leading dim ``capacity`` and ``overflow`` is the
+    scalar int32 count of contributing rows DROPPED because they fell
+    past ``capacity``. Rows beyond the number of contributing positions
+    have weight 0. Overflow silently biases the loss (the dropped rows'
+    gradients vanish), so callers must surface a nonzero count instead
+    of swallowing it — size ``capacity`` generously (module docstring)
+    and treat ``overflow > 0`` as a configuration error to report.
     """
     n, c = hidden.shape
     contributes = weight > 0
     dest = jnp.cumsum(contributes.astype(jnp.int32)) - 1
+    # all-zero weight: cumsum[-1]=0 → dest[-1]+1 = 0, no guard needed
+    n_contributing = dest[-1] + 1
+    overflow = jnp.maximum(n_contributing - capacity, 0)
     # non-contributing and overflow rows all land on a dump row that is
     # sliced off below (duplicate scatter indices are fine there)
     dest = jnp.where(contributes & (dest < capacity), dest, capacity)
@@ -52,7 +59,8 @@ def pack_positions(hidden, labels, weight, capacity: int):
     labels_p = jnp.zeros((capacity + 1,), labels.dtype).at[dest].set(labels)
     weight_p = jnp.zeros((capacity + 1,), jnp.float32).at[dest].set(
         weight.astype(jnp.float32))
-    return hidden_p[:capacity], labels_p[:capacity], weight_p[:capacity]
+    return (hidden_p[:capacity], labels_p[:capacity], weight_p[:capacity],
+            overflow)
 
 
 def fused_linear_cross_entropy(linear_params, hidden, labels, weight, *,

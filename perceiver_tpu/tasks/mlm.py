@@ -179,6 +179,7 @@ class MaskedLanguageModelTask(TaskConfig):
         hidden = hidden.reshape(b * l, c)
         labels = labels.reshape(b * l)
         weight = weight.reshape(b * l)
+        metrics = {}
         if self.loss_impl in ("packed", "pallas"):
             n = b * l
             if self.packed_capacity is not None:
@@ -190,8 +191,24 @@ class MaskedLanguageModelTask(TaskConfig):
                 sigma = (n * p * (1.0 - p)) ** 0.5
                 cap = int(n * p + 6.0 * sigma) + 8
             cap = min(max(cap, 1), n)
-            hidden, labels, weight = pack_positions(hidden, labels, weight,
-                                                    cap)
+            hidden, labels, weight, overflow = pack_positions(
+                hidden, labels, weight, cap)
+            # overflow = contributing rows silently dropped by the
+            # static capacity: it biases the loss, so it must be
+            # observable — as a TB scalar (train_ce_overflow) and as a
+            # loud in-stream warning the moment it first goes nonzero
+            import jax
+
+            jax.lax.cond(
+                overflow > 0,
+                lambda ov: jax.debug.print(
+                    "WARNING: packed-CE capacity overflow — {n} "
+                    "contributing positions dropped from the loss; "
+                    "raise packed_capacity or use loss_impl='fused'",
+                    n=ov),
+                lambda ov: None,
+                overflow)
+            metrics["ce_overflow"] = overflow
         adapter_params = params["decoder"]["output_adapter"]["linear"]
         if self.loss_impl == "pallas":
             from perceiver_tpu.ops.pallas_ce import (
@@ -204,4 +221,4 @@ class MaskedLanguageModelTask(TaskConfig):
                 adapter_params, hidden, labels, weight,
                 chunk_size=min(self.ce_chunk_size, hidden.shape[0]),
                 policy=policy)
-        return loss, {"loss": loss}
+        return loss, {"loss": loss, **metrics}

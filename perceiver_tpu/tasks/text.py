@@ -28,6 +28,20 @@ class TextClassifierTask(TaskConfig):
     # same token layout as the MLM task (shared encoder)
     seq_partition_fields = ("input_ids", "pad_mask")
 
+    def __post_init__(self):
+        super().__post_init__()
+        # exactly one transfer source may be given: restore_pretrained
+        # resolves them by fixed precedence, so a second flag would be
+        # IGNORED silently — reject the ambiguity instead (ADVICE r2)
+        given = [name for name in
+                 ("mlm_ckpt", "clf_ckpt", "torch_ckpt", "torch_mlm_ckpt")
+                 if getattr(self, name) is not None]
+        if len(given) > 1:
+            raise ValueError(
+                f"conflicting transfer sources {given}: pass at most one "
+                "of --model.mlm_ckpt / --model.clf_ckpt / "
+                "--model.torch_ckpt / --model.torch_mlm_ckpt")
+
     def build(self, mesh=None) -> PerceiverIO:
         encoder = create_encoder(self, self.vocab_size, self.max_seq_len,
                                  mesh=mesh)

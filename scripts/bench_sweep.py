@@ -25,7 +25,7 @@ ROOT = os.path.join(os.path.dirname(__file__), "..")
 best = None
 for b, impl, inner in itertools.product(BATCHES, IMPLS, INNER):
     env = dict(os.environ, BENCH_BATCH=str(b), BENCH_LOSS_IMPL=impl,
-               BENCH_INNER_STEPS=str(inner))
+               BENCH_INNER_STEPS=str(inner), BENCH_WAIT="0")
     tag = f"batch {b:5d} {impl:6s} inner {inner:2d}"
     try:
         out = subprocess.run(

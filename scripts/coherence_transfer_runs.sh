@@ -1,0 +1,66 @@
+#!/bin/bash
+# Scratch-vs-transfer comparison on the COHERENCE corpus (VERDICT r2
+# #4): labels that bag-of-words provably cannot solve (the BoW control
+# in QUALITY_r03.json sits at chance), so an end-task win for the
+# MLM-transfer recipe measures representation quality, not keyword
+# lookup. Equal total budget: scratch 600 steps vs transfer 300
+# (frozen phase 1) + 300 (unfrozen phase 2); plus the frozen-RANDOM-
+# encoder probe as the control for the frozen-MLM probe.
+#
+# Usage: scripts/coherence_transfer_runs.sh [mlm_ckpt_dir]
+set -u
+cd "$(dirname "$0")/.."
+
+DATA=.cache_coh
+[[ -d $DATA/aclImdb ]] || { echo "run make_coherence_corpus.py first"; exit 1; }
+
+# default MLM source: furthest-step checkpoint across the quality runs
+MLM_CKPT=${1:-}
+if [[ -z "$MLM_CKPT" ]]; then
+  best_step=-1
+  for d in logs/mlm_quality/version_*/checkpoints* \
+           logs/mlm_quality_resumed_on_cpu/version_*/checkpoints* \
+           logs/mlm_cpu_quality/version_*/checkpoints*; do
+    [[ -d "$d" ]] || continue
+    for s in "$d"/*/; do
+      s=${s%/}; s=${s##*/}
+      [[ "$s" =~ ^[0-9]+$ ]] || continue
+      if (( s > best_step )); then best_step=$s; MLM_CKPT=$d; fi
+    done
+  done
+  echo "using MLM checkpoint $MLM_CKPT (step $best_step)"
+fi
+
+COMMON=(--data.data_dir=$DATA --data.batch_size=32
+        --trainer.log_every_n_steps=50 --trainer.accelerator=cpu)
+
+run() {
+  local name=$1; shift
+  if ls "logs/$name"/version_*/events.* > /dev/null 2>&1; then
+    echo "== $name already has a run — skipping"
+    return 0
+  fi
+  echo "== $name: $(date -u +%FT%TZ)"
+  python scripts/seq_clf.py fit "${COMMON[@]}" --experiment="$name" "$@" \
+    > "logs/$name.log" 2>&1
+  echo "== $name done rc=$? $(date -u +%FT%TZ)"
+}
+
+# control: frozen RANDOM encoder probe (what does the architecture +
+# trainable decoder get on its own?)
+run coh_frozen_random --model.freeze_encoder=true --trainer.max_steps=300
+
+# phase 1: frozen MLM encoder probe
+run coh_phase1 --model.freeze_encoder=true --model.mlm_ckpt="$MLM_CKPT" \
+    --trainer.max_steps=300
+
+# phase 2: unfreeze from the phase-1 checkpoint, reference recipe lr
+PH1=$(ls -d logs/coh_phase1/version_*/checkpoints 2>/dev/null | sort -V | tail -1)
+run coh_phase2 --model.clf_ckpt="$PH1" --optimizer.init_args.lr=0.0001 \
+    --trainer.max_steps=300
+
+# scratch at the SAME total budget as phase1+phase2
+run coh_scratch --trainer.max_steps=600
+
+python scripts/quality_summary.py coh_frozen_random coh_phase1 \
+  coh_phase2 coh_scratch | tee QUALITY_r03_coherence.json

@@ -26,6 +26,7 @@ Usage: python scripts/harvest_text.py [--out .cache] [--max-docs N]
 
 import argparse
 import ast
+import glob
 import hashlib
 import os
 import random
@@ -152,8 +153,19 @@ def main():
     splits = {"test": docs[:n_test], "train": docs[n_test:]}
     total_bytes = 0
     # a prior harvest (possibly differently labeled) must not leave
-    # stale files mixed into this one
+    # stale files mixed into this one — and a rewritten corpus must
+    # also invalidate the cached tokenizer and tokenized-array npz
+    # (IMDBDataModule only retrains the tokenizer when its json is
+    # missing; the npz cache additionally fingerprints the corpus, but
+    # deleting both here keeps even old-format caches honest)
     shutil.rmtree(os.path.join(args.out, "aclImdb"), ignore_errors=True)
+    for stale in glob.glob(os.path.join(args.out,
+                                        "imdb-tokenizer-*.json")) + \
+            glob.glob(os.path.join(args.out, "*-ids-L*.npz")):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
     n_dropped = 0
     api_words = re.compile(
         r"\b(parameter|argument|returns?|default|callable|iterable|"

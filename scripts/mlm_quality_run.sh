@@ -12,7 +12,7 @@ cd "$(dirname "$0")/.."
 MAX_STEPS=${1:-50000}
 shift || true
 
-EXP=mlm_tpu_quality
+EXP=mlm_quality
 # The CPU hedge run (same corpus/config) would fight this run for the
 # single host core; stop it — its progress carries over via the
 # furthest-step checkpoint selection below. SIGTERM triggers its
@@ -34,6 +34,7 @@ fi
 RESUME=()
 best_dir=""; best_step=-1
 for d in logs/$EXP/version_*/checkpoints* \
+         logs/mlm_quality_resumed_on_cpu/version_*/checkpoints* \
          logs/mlm_cpu_quality/version_*/checkpoints*; do
   [[ -d "$d" ]] || continue
   for s in "$d"/*/; do

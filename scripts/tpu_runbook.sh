@@ -41,9 +41,10 @@ run_stage() {
 for s in $STAGES; do
   case $s in
     bench)   # primary metric: MLM tokens/sec/chip + MFU (ladder)
-      run_stage bench timeout 3000 python bench.py ;;
+      run_stage bench env BENCH_WAIT=0 timeout 3000 python bench.py ;;
     img)     # secondary metric: MNIST imgs/sec/chip
-      run_stage img env BENCH_TASK=img_clf timeout 1800 python bench.py ;;
+      run_stage img env BENCH_WAIT=0 BENCH_TASK=img_clf \
+        timeout 1800 python bench.py ;;
     kernels) # flash/chunked/einsum on-chip microbench (VERDICT #2),
              # with the flash layout A/B (std vs transposed)
       run_stage kernels env KERNEL_SHAPES="$KSHAPES" \
@@ -57,7 +58,7 @@ for s in $STAGES; do
         "${SEG_ACCEL[@]}" \
         --logdir "$OUT/seg_logs" --ckpt-dir "$OUT/seg_ckpt" ;;
     segbench) # pixels/sec JSON line for the 262k-query config
-      run_stage segbench env BENCH_TASK=seg "${SEGB_ENV[@]}" \
+      run_stage segbench env BENCH_WAIT=0 BENCH_TASK=seg "${SEGB_ENV[@]}" \
         timeout 1800 python bench.py ;;
     sweep)   # batch/inner/loss_impl tuning sweep (longest; last)
       run_stage sweep timeout 6000 python scripts/bench_sweep.py \

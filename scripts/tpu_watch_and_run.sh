@@ -71,6 +71,24 @@ step() {
   return 1
 }
 
+# Checkpoint dir holding the FURTHEST committed numeric orbax step
+# across the given experiment globs (ADVICE r2: `ls -dt | head -1`
+# picks mtime-newest, which lies — a freshly-created version dir with
+# only hparams.json, or the slow CPU hedge, can shadow the
+# furthest-trained run). Mirrors mlm_quality_run.sh's resume scan.
+furthest_ckpt() {
+  local best_dir="" best_step=-1 d s
+  for d in "$@"; do
+    [[ -d "$d" ]] || continue
+    for s in "$d"/*/; do
+      s=${s%/}; s=${s##*/}
+      [[ "$s" =~ ^[0-9]+$ ]] || continue
+      if (( s > best_step )); then best_step=$s; best_dir=$d; fi
+    done
+  done
+  echo "$best_dir"
+}
+
 say "watcher started (pid $$)"
 while true; do
   if ! probe; then
@@ -82,27 +100,29 @@ while true; do
 
   # Priority order, smallest/fastest first. || continue goes back to
   # probing as soon as a step fails so we do not burn a dead tunnel.
-  step bench_b64    480  240 env BENCH_BATCH=64  BENCH_INNER_STEPS=1 BENCH_LOSS_IMPL=packed python bench.py || continue
-  step bench_b256   600  240 env BENCH_BATCH=256 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
-  step bench_b512   720  300 env BENCH_BATCH=512 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
-  step img_b256     600  240 env BENCH_TASK=img_clf BENCH_BATCH=256 BENCH_INNER_STEPS=8 python bench.py || continue
+  step bench_b64    480  240 env BENCH_WAIT=0 BENCH_BATCH=64  BENCH_INNER_STEPS=1 BENCH_LOSS_IMPL=packed python bench.py || continue
+  step bench_b256   600  240 env BENCH_WAIT=0 BENCH_BATCH=256 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
+  step bench_b512   720  300 env BENCH_WAIT=0 BENCH_BATCH=512 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
+  step img_b256     600  240 env BENCH_WAIT=0 BENCH_TASK=img_clf BENCH_BATCH=256 BENCH_INNER_STEPS=8 python bench.py || continue
   step kernels_mlm  900  420 env KERNEL_SHAPES=mnist,mlm KERNEL_REPS=20 python scripts/bench_kernels.py einsum chunked flash_std flash_t || continue
   step kernels_seg 1200  600 env KERNEL_SHAPES=seg,lm2048 KERNEL_REPS=10 python scripts/bench_kernels.py einsum chunked flash_std flash_t || continue
   step memcheck     900  600 python scripts/aot_memcheck.py all || continue
   step seg_step    1200  600 python run.py --size 512 --num-synthetic 8 --batch-size 2 --epochs 1 --val-events 0 --logdir "$OUT/seg_logs" --ckpt-dir "$OUT/seg_ckpt" || continue
-  step segbench    1200  600 env BENCH_TASK=seg BENCH_BATCH=2 BENCH_INNER_STEPS=1 python bench.py || continue
-  step bench_b1024  900  300 env BENCH_BATCH=1024 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
+  step segbench    1200  600 env BENCH_WAIT=0 BENCH_TASK=seg BENCH_BATCH=2 BENCH_INNER_STEPS=1 python bench.py || continue
+  step bench_b1024  900  300 env BENCH_WAIT=0 BENCH_BATCH=1024 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
   step sweep       4800  600 python scripts/bench_sweep.py || continue
   # long tail: real-text MLM quality training (resumable across
   # windows via mlm_quality_run.sh's newest-checkpoint lookup), then
   # the two-phase seq_clf transfer on its best checkpoint
   step mlm_quality 14400 900 bash scripts/mlm_quality_run.sh 50000 || continue
   step clf_phase1  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache \
-      --model.mlm_ckpt="$(ls -dt logs/mlm_tpu_quality/version_*/checkpoints 2>/dev/null | head -1)" \
+      --model.mlm_ckpt="$(furthest_ckpt logs/mlm_quality/version_*/checkpoints* \
+                          logs/mlm_quality_resumed_on_cpu/version_*/checkpoints* \
+                          logs/mlm_cpu_quality/version_*/checkpoints*)" \
       --model.freeze_encoder=true --trainer.max_steps=3000 \
       --trainer.steps_per_execution=8 --experiment=clf_tpu_phase1 || continue
   step clf_phase2  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache \
-      --model.clf_ckpt="$(ls -dt logs/clf_tpu_phase1/version_*/checkpoints 2>/dev/null | head -1)" \
+      --model.clf_ckpt="$(furthest_ckpt logs/clf_tpu_phase1/version_*/checkpoints*)" \
       --optimizer.init_args.lr=0.0001 --trainer.max_steps=1500 \
       --trainer.steps_per_execution=8 --experiment=clf_tpu_phase2 || continue
   say "ALL EVIDENCE COLLECTED"

@@ -1,0 +1,129 @@
+"""bench.py supervisor: bounded wait-retry around transient TPU windows.
+
+VERDICT r2 weak #1: the driver's end-of-round bench is the one chance
+to record an on-chip number, and round 2's single ~1-minute tunnel
+window was wasted because bench.py exited on the first failed probe.
+These tests drive ``supervise()`` in-process with the probe and the
+child-bench launch monkeypatched, so the retry policy (wait through
+down windows, relaunch after a watchdog-killed child, give up fast on
+deterministic failures) is pinned without any hardware.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    # bench.py lives at the repo root (driver contract), not in the
+    # package — load it by path. A fresh module per test keeps the
+    # monkeypatched attributes isolated.
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    monkeypatch.setenv("BENCH_WATCHDOG", "0")  # no daemon hard-exit
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_supervisor_exhausts_budget_when_backend_never_up(
+        bench, monkeypatch):
+    probes = []
+    monkeypatch.setattr(bench, "_exec_probe",
+                        lambda *a, **k: probes.append(1) is not None and False)
+    monkeypatch.setenv("BENCH_WAIT", "0.3")
+    monkeypatch.setenv("BENCH_PROBE_INTERVAL", "0.1")
+    rc = bench.supervise()
+    assert rc == 4
+    assert len(probes) >= 2  # kept re-probing, not one-shot
+
+
+def test_supervisor_launches_child_on_first_good_probe(bench, monkeypatch):
+    calls = []
+    monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
+
+    def fake_call(cmd, env=None):
+        calls.append(env)
+        return 0
+
+    monkeypatch.setattr(bench.subprocess, "call", fake_call)
+    monkeypatch.setenv("BENCH_WAIT", "60")
+    rc = bench.supervise()
+    assert rc == 0
+    assert len(calls) == 1
+    # the child must run the ladder directly, not recurse into a
+    # second supervisor
+    assert calls[0]["BENCH_WAIT"] == "0"
+
+
+def test_supervisor_retries_after_watchdog_killed_child(bench, monkeypatch):
+    # rc=3 is the in-child watchdog's half-dead-tunnel exit, rc=5 the
+    # child's backend-unavailable exit: the window closed mid-run /
+    # right after the probe. The supervisor must go back to probing
+    # (and can succeed in a later window) instead of giving up —
+    # round 2 observed ~1-minute windows, so two such events within
+    # hours of budget are expected, not deterministic failures.
+    monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
+    rcs = iter([3, 5, 0])
+    calls = []
+    monkeypatch.setattr(bench.subprocess, "call",
+                        lambda cmd, env=None: (calls.append(1), next(rcs))[1])
+    monkeypatch.setenv("BENCH_WAIT", "60")
+    monkeypatch.setenv("BENCH_PROBE_INTERVAL", "0.05")
+    rc = bench.supervise()
+    assert rc == 0
+    assert len(calls) == 3
+
+
+def test_supervisor_gives_up_on_deterministic_failure(bench, monkeypatch):
+    # A child that COMPLETES and fails (rc=1: every ladder config
+    # raised) twice in a row is a code/config problem, not a tunnel
+    # flake — burning the remaining budget on relaunches would delay
+    # the driver for hours with no possible payoff.
+    monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
+    calls = []
+    monkeypatch.setattr(bench.subprocess, "call",
+                        lambda cmd, env=None: calls.append(1) or 1)
+    monkeypatch.setenv("BENCH_WAIT", "3600")
+    monkeypatch.setenv("BENCH_PROBE_INTERVAL", "0.05")
+    rc = bench.supervise()
+    assert rc == 1
+    assert len(calls) == 2
+
+
+def test_supervisor_disables_own_watchdog(bench, monkeypatch):
+    # While blocked in subprocess.call on a healthy long-running child,
+    # nothing kicks the supervisor's in-process watchdog — it must be
+    # inert in supervisor mode or it hard-exits rc=3 mid-child.
+    monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
+    seen = []
+    monkeypatch.setattr(
+        bench.subprocess, "call",
+        lambda cmd, env=None: seen.append(bench._WATCHDOG.timeout) or 0)
+    monkeypatch.setenv("BENCH_WAIT", "60")
+    assert bench.supervise() == 0
+    assert seen == [0]  # disabled before the child ran
+
+
+def test_cpu_smoke_skips_supervisor(bench, monkeypatch):
+    # BENCH_PLATFORM=cpu (smoke runs, sweeps) must go straight to the
+    # ladder — probing for a TPU would always fail and eat BENCH_WAIT.
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    monkeypatch.setenv("BENCH_WAIT", "3600")
+    monkeypatch.setattr(
+        bench, "supervise",
+        lambda: (_ for _ in ()).throw(AssertionError("supervise called")))
+    # stop main() before the heavy ladder: probe_backend is the first
+    # thing the direct path calls; its failure exits rc=5 (transient-
+    # tunnel signal), proving the direct path ran and supervise didn't
+    sentinel = RuntimeError("direct path reached")
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda: (_ for _ in ()).throw(sentinel))
+    with pytest.raises(SystemExit) as exc:
+        bench.main()
+    assert exc.value.code == 5
