@@ -15,35 +15,37 @@ DATA=.cache_coh
 [[ -d $DATA/aclImdb ]] || { echo "run make_coherence_corpus.py first"; exit 1; }
 
 # default MLM source: furthest-step checkpoint across the quality runs
+. scripts/lib_ckpt.sh
 MLM_CKPT=${1:-}
 if [[ -z "$MLM_CKPT" ]]; then
-  best_step=-1
-  for d in logs/mlm_quality/version_*/checkpoints* \
-           logs/mlm_quality_resumed_on_cpu/version_*/checkpoints* \
-           logs/mlm_cpu_quality/version_*/checkpoints*; do
-    [[ -d "$d" ]] || continue
-    for s in "$d"/*/; do
-      s=${s%/}; s=${s##*/}
-      [[ "$s" =~ ^[0-9]+$ ]] || continue
-      if (( s > best_step )); then best_step=$s; MLM_CKPT=$d; fi
-    done
-  done
-  echo "using MLM checkpoint $MLM_CKPT (step $best_step)"
+  MLM_CKPT=$(furthest_ckpt $(mlm_quality_ckpt_globs))
+  echo "using MLM checkpoint $MLM_CKPT"
 fi
+[[ -d "$MLM_CKPT" ]] || { echo "no MLM checkpoint found"; exit 1; }
 
 COMMON=(--data.data_dir=$DATA --data.batch_size=32
         --trainer.log_every_n_steps=50 --trainer.accelerator=cpu)
 
+# A failed arm must FAIL the script (no summary from a partial
+# comparison) and must not poison reruns: completion is recorded by a
+# .done sentinel written only on rc=0, never inferred from the event
+# files a crashed run leaves behind.
 run() {
   local name=$1; shift
-  if ls "logs/$name"/version_*/events.* > /dev/null 2>&1; then
-    echo "== $name already has a run — skipping"
+  if [[ -e "logs/$name.done" ]]; then
+    echo "== $name already complete — skipping"
     return 0
   fi
   echo "== $name: $(date -u +%FT%TZ)"
   python scripts/seq_clf.py fit "${COMMON[@]}" --experiment="$name" "$@" \
     > "logs/$name.log" 2>&1
-  echo "== $name done rc=$? $(date -u +%FT%TZ)"
+  local rc=$?
+  echo "== $name done rc=$rc $(date -u +%FT%TZ)"
+  if (( rc != 0 )); then
+    echo "== $name FAILED — aborting (see logs/$name.log)"
+    exit "$rc"
+  fi
+  touch "logs/$name.done"
 }
 
 # control: frozen RANDOM encoder probe (what does the architecture +
@@ -55,7 +57,8 @@ run coh_phase1 --model.freeze_encoder=true --model.mlm_ckpt="$MLM_CKPT" \
     --trainer.max_steps=300
 
 # phase 2: unfreeze from the phase-1 checkpoint, reference recipe lr
-PH1=$(ls -d logs/coh_phase1/version_*/checkpoints 2>/dev/null | sort -V | tail -1)
+PH1=$(furthest_ckpt logs/coh_phase1/version_*/checkpoints*)
+[[ -d "$PH1" ]] || { echo "no phase-1 checkpoint"; exit 1; }
 run coh_phase2 --model.clf_ckpt="$PH1" --optimizer.init_args.lr=0.0001 \
     --trainer.max_steps=300
 

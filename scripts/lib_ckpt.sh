@@ -1,0 +1,33 @@
+# Shared checkpoint-selection helpers (source this; no shebang).
+#
+# furthest_ckpt DIR_GLOB... — print the checkpoints dir holding the
+# FURTHEST committed numeric orbax step across the given dirs. Mtime
+# (`ls -dt | head -1`) lies: a freshly-created version dir holding only
+# hparams.json, or a slow CPU hedge that saved recently, can shadow the
+# furthest-trained run (ADVICE r2).
+furthest_ckpt() {
+  local best_dir="" best_step=-1 d s
+  # version-sorted (sort -V: version_10 after version_9) with ties on
+  # step going to the LATER dir — a rerun that reaches the same
+  # max_steps must win over the stale earlier version
+  while IFS= read -r d; do
+    [[ -d "$d" ]] || continue
+    for s in "$d"/*/; do
+      s=${s%/}; s=${s##*/}
+      [[ "$s" =~ ^[0-9]+$ ]] || continue
+      if (( s >= best_step )); then best_step=$s; best_dir=$d; fi
+    done
+  done < <(printf '%s\n' "$@" | sort -V)
+  echo "$best_dir"
+}
+
+# The MLM quality experiments, in every place they may have written
+# checkpoints (regular + preempt saves, TPU watcher runs, CPU hedge,
+# the round-2 dir renamed for truthful labeling). Keep this list in ONE
+# place: a dir added here is picked up by the quality-run resume, the
+# watcher's transfer phases, and the coherence comparison alike.
+mlm_quality_ckpt_globs() {
+  echo logs/mlm_quality/version_*/checkpoints* \
+       logs/mlm_quality_resumed_on_cpu/version_*/checkpoints* \
+       logs/mlm_cpu_quality/version_*/checkpoints*
+}

@@ -27,25 +27,13 @@ if pgrep -f "scripts/mlm.py.*mlm_cpu_quality" > /dev/null 2>&1; then
 fi
 
 # Resume from the checkpoint dir holding the FURTHEST committed step
-# (numeric orbax step subdirs), across this experiment's versions
-# (regular + preempt saves) and the CPU hedge's. Mtime would lie: a
-# fresh dir holds only hparams.json before the first save, and the
-# slow CPU hedge saves more recently than a further-along TPU run.
+# across all MLM quality experiment dirs (shared helper — ADVICE r2).
+. scripts/lib_ckpt.sh  # cwd is the repo root (cd at top)
 RESUME=()
-best_dir=""; best_step=-1
-for d in logs/$EXP/version_*/checkpoints* \
-         logs/mlm_quality_resumed_on_cpu/version_*/checkpoints* \
-         logs/mlm_cpu_quality/version_*/checkpoints*; do
-  [[ -d "$d" ]] || continue
-  for s in "$d"/*/; do
-    s=${s%/}; s=${s##*/}
-    [[ "$s" =~ ^[0-9]+$ ]] || continue
-    if (( s > best_step )); then best_step=$s; best_dir=$d; fi
-  done
-done
+best_dir=$(furthest_ckpt $(mlm_quality_ckpt_globs))
 if [[ -n "$best_dir" ]]; then
   RESUME=(--trainer.resume_from_checkpoint "$best_dir")
-  echo "resuming from $best_dir (step $best_step)"
+  echo "resuming from $best_dir"
 fi
 
 exec python scripts/mlm.py fit \

@@ -71,23 +71,7 @@ step() {
   return 1
 }
 
-# Checkpoint dir holding the FURTHEST committed numeric orbax step
-# across the given experiment globs (ADVICE r2: `ls -dt | head -1`
-# picks mtime-newest, which lies — a freshly-created version dir with
-# only hparams.json, or the slow CPU hedge, can shadow the
-# furthest-trained run). Mirrors mlm_quality_run.sh's resume scan.
-furthest_ckpt() {
-  local best_dir="" best_step=-1 d s
-  for d in "$@"; do
-    [[ -d "$d" ]] || continue
-    for s in "$d"/*/; do
-      s=${s%/}; s=${s##*/}
-      [[ "$s" =~ ^[0-9]+$ ]] || continue
-      if (( s > best_step )); then best_step=$s; best_dir=$d; fi
-    done
-  done
-  echo "$best_dir"
-}
+. scripts/lib_ckpt.sh  # furthest_ckpt + mlm_quality_ckpt_globs
 
 say "watcher started (pid $$)"
 while true; do
@@ -116,9 +100,7 @@ while true; do
   # the two-phase seq_clf transfer on its best checkpoint
   step mlm_quality 14400 900 bash scripts/mlm_quality_run.sh 50000 || continue
   step clf_phase1  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache \
-      --model.mlm_ckpt="$(furthest_ckpt logs/mlm_quality/version_*/checkpoints* \
-                          logs/mlm_quality_resumed_on_cpu/version_*/checkpoints* \
-                          logs/mlm_cpu_quality/version_*/checkpoints*)" \
+      --model.mlm_ckpt="$(furthest_ckpt $(mlm_quality_ckpt_globs))" \
       --model.freeze_encoder=true --trainer.max_steps=3000 \
       --trainer.steps_per_execution=8 --experiment=clf_tpu_phase1 || continue
   step clf_phase2  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache \

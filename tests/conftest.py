@@ -51,6 +51,7 @@ _SLOW = {
     "test_training.py::test_terminate_on_nan_raises[1]",
     "test_training.py::test_terminate_on_nan_raises[50]",
     "test_training.py::test_text_classifier_transfer_and_freeze",
+    "test_training.py::test_trainer_fit_resume_degrades_across_scheduler_change",
     "test_steps_per_execution.py::test_matches_single_step",
     "test_steps_per_execution.py::test_trailing_partial_group",
     "test_steps_per_execution.py::test_max_steps_not_overshot",
