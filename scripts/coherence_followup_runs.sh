@@ -1,0 +1,63 @@
+#!/bin/bash
+# Follow-up arms for the coherence comparison (round 3): symmetric lr
+# tuning for the transfer side (scratch got a 3-point lr sweep, so
+# phase 2 gets one too), plus the few-shot regime (512 labeled
+# examples, full test split) where pretrained representations matter
+# most — the label-efficiency claim behind the reference's two-phase
+# recipe. Resumable via the same .done sentinels as the main chain.
+set -u
+cd "$(dirname "$0")/.."
+. scripts/lib_ckpt.sh
+
+COMMON=(--data.batch_size=32 --trainer.log_every_n_steps=50
+        --trainer.accelerator=cpu)
+
+run() {
+  local name=$1; shift
+  if [[ -e "logs/$name.done" ]]; then
+    echo "== $name already complete — skipping"
+    return 0
+  fi
+  echo "== $name: $(date -u +%FT%TZ)"
+  python scripts/seq_clf.py fit "${COMMON[@]}" --experiment="$name" "$@" \
+    > "logs/$name.log" 2>&1
+  local rc=$?
+  echo "== $name done rc=$rc $(date -u +%FT%TZ)"
+  if (( rc != 0 )); then
+    echo "== $name FAILED — aborting (see logs/$name.log)"
+    exit "$rc"
+  fi
+  touch "logs/$name.done"
+}
+
+PH1=$(furthest_ckpt logs/coh_phase1/version_*/checkpoints*)
+[[ -d "$PH1" ]] || { echo "no phase-1 checkpoint"; exit 1; }
+MLM_CKPT=$(furthest_ckpt $(mlm_quality_ckpt_globs))
+[[ -d "$MLM_CKPT" ]] || { echo "no MLM checkpoint"; exit 1; }
+
+# --- symmetric phase-2 lr tuning (full 4.9k-example train set) -------
+run coh_phase2_lr0.0003 --data.data_dir=.cache_coh \
+    --model.clf_ckpt="$PH1" --optimizer.init_args.lr=0.0003 \
+    --trainer.max_steps=300
+run coh_phase2_lr0.001 --data.data_dir=.cache_coh \
+    --model.clf_ckpt="$PH1" --optimizer.init_args.lr=0.001 \
+    --trainer.max_steps=300
+
+# --- few-shot regime: 512 labeled examples, same 246-example test ----
+FS=(--data.data_dir=.cache_coh_small)
+run fs_frozen_random "${FS[@]}" --model.freeze_encoder=true \
+    --trainer.max_steps=300
+run fs_phase1 "${FS[@]}" --model.freeze_encoder=true \
+    --model.mlm_ckpt="$MLM_CKPT" --trainer.max_steps=300
+FSPH1=$(furthest_ckpt logs/fs_phase1/version_*/checkpoints*)
+[[ -d "$FSPH1" ]] || { echo "no fs_phase1 checkpoint"; exit 1; }
+run fs_phase2 "${FS[@]}" --model.clf_ckpt="$FSPH1" \
+    --optimizer.init_args.lr=0.0001 --trainer.max_steps=300
+# scratch at the same total budget, with the two lrs that worked best
+# on the full set
+run fs_scratch_lr0.0001 "${FS[@]}" --optimizer.init_args.lr=0.0001 \
+    --trainer.max_steps=600
+run fs_scratch_lr0.0003 "${FS[@]}" --optimizer.init_args.lr=0.0003 \
+    --trainer.max_steps=600
+
+bash scripts/coherence_summary.sh

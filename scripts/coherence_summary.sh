@@ -1,0 +1,20 @@
+#!/bin/bash
+# Regenerate QUALITY_r03_coherence.json from EVERY coherence arm that
+# has produced logs — the single writer for this file, so neither the
+# main chain nor the follow-up chain can clobber the other's arms
+# (each used to emit its own subset; a rerun of the shorter script
+# silently dropped the longer one's experiments).
+set -u
+cd "$(dirname "$0")/.."
+
+ARMS=(coh_frozen_random coh_phase1 coh_phase2 coh_phase2_lr0.0003
+      coh_phase2_lr0.001 coh_scratch coh_scratch_lr0.0003
+      coh_scratch_lr0.0001 fs_frozen_random fs_phase1 fs_phase2
+      fs_scratch_lr0.0001 fs_scratch_lr0.0003)
+have=()
+for a in "${ARMS[@]}"; do
+  ls "logs/$a"/version_*/events.* > /dev/null 2>&1 && have+=("$a")
+done
+(( ${#have[@]} > 0 )) || { echo "no coherence arms found"; exit 1; }
+python scripts/quality_summary.py "${have[@]}" > QUALITY_r03_coherence.json
+echo "QUALITY_r03_coherence.json: ${#have[@]} arms (${have[*]})"

@@ -65,5 +65,4 @@ run coh_phase2 --model.clf_ckpt="$PH1" --optimizer.init_args.lr=0.0001 \
 # scratch at the SAME total budget as phase1+phase2
 run coh_scratch --trainer.max_steps=600
 
-python scripts/quality_summary.py coh_frozen_random coh_phase1 \
-  coh_phase2 coh_scratch | tee QUALITY_r03_coherence.json
+bash scripts/coherence_summary.sh

@@ -13,15 +13,18 @@ MAX_STEPS=${1:-50000}
 shift || true
 
 EXP=mlm_quality
-# The CPU hedge run (same corpus/config) would fight this run for the
-# single host core; stop it — its progress carries over via the
-# furthest-step checkpoint selection below. SIGTERM triggers its
-# preemption save, which can take a while on a loaded host: wait for
-# the process to actually exit so the save is complete, not racing.
-if pgrep -f "scripts/mlm.py.*mlm_cpu_quality" > /dev/null 2>&1; then
-  pkill -f "scripts/mlm.py.*mlm_cpu_quality"
-  for _ in $(seq 1 90); do
-    pgrep -f "scripts/mlm.py.*mlm_cpu_quality" > /dev/null 2>&1 || break
+# A running CPU hedge/quality instance (same corpus/config, any of the
+# experiment names) would fight this run for the single host core;
+# stop it — its progress carries over via the furthest-step checkpoint
+# selection below. SIGTERM triggers its preemption save, which can
+# take a while on a loaded host: wait for the process to actually exit
+# so the save is complete, not racing. (Never matches this process:
+# the pattern targets already-exec'd scripts/mlm.py processes.)
+HEDGE_PAT="scripts/mlm.py fit.*(mlm_cpu_quality|experiment=mlm_quality)"
+if pgrep -f "$HEDGE_PAT" > /dev/null 2>&1; then
+  pkill -f "$HEDGE_PAT"
+  for _ in $(seq 1 150); do
+    pgrep -f "$HEDGE_PAT" > /dev/null 2>&1 || break
     sleep 2
   done
 fi

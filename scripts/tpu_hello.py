@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Smallest possible on-chip evidence, for ~1-minute tunnel windows.
+
+Three escalating proofs, each flushed as its own JSON line the moment
+it completes, so a tunnel death mid-script still leaves the earlier
+evidence on disk:
+
+1. ``device``  — backend up: platform, device_kind.
+2. ``matmul``  — XLA executes: timed 4096² bf16 matmul, TFLOP/s.
+3. ``pallas``  — MOSAIC COMPILES: the flash-attention kernel
+   (``ops/pallas_attention.py``) run at a small MLM-shaped block with
+   ``interpret=None`` (auto: real kernel on TPU), checked against the
+   einsum reference. This is the one-line answer to "no Pallas kernel
+   has ever been compiled by Mosaic" (VERDICT r2) — it needs ~15 s of
+   window, not the full bench ladder.
+
+Each stage has its own watchdog (os._exit on stall) so a half-dead
+tunnel costs seconds, not the window.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _out(obj):
+    print(json.dumps(obj), flush=True)
+
+
+_DEADLINE = [time.monotonic() + 90.0]
+
+
+def _arm(seconds: float):
+    _DEADLINE[0] = time.monotonic() + seconds
+
+
+def _watchdog():
+    while True:
+        time.sleep(2)
+        if time.monotonic() > _DEADLINE[0]:
+            print(json.dumps({"stage": "watchdog",
+                              "error": "stalled — tunnel presumed dead"}),
+                  flush=True)
+            os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+
+def main():
+    t0 = time.perf_counter()
+    _arm(90)
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    _out({"stage": "device", "platform": dev.platform,
+          "device_kind": getattr(dev, "device_kind", None),
+          "init_s": round(time.perf_counter() - t0, 1)})
+
+    # -- XLA executes ----------------------------------------------------
+    _arm(120)
+    n = 4096
+    x = jnp.ones((n, n), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    f(x).block_until_ready()  # compile + first run
+    t = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        y = f(x)
+    y.block_until_ready()
+    dt = time.perf_counter() - t
+    _out({"stage": "matmul", "n": n,
+          "tflops": round(2 * n**3 * reps / dt / 1e12, 2),
+          "platform": dev.platform})
+
+    # -- Mosaic compiles the flash kernel --------------------------------
+    _arm(180)
+    from perceiver_tpu.ops.pallas_attention import flash_attention
+
+    def einsum_attention_reference(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (q.shape[-1] ** 0.5)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+    b, h, lq, lk, d = 4, 4, 128, 512, 64
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, lq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, lk, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, lk, d), jnp.float32)
+    t = time.perf_counter()
+    o = flash_attention(q, k, v)  # interpret=None → real kernel on TPU
+    o.block_until_ready()
+    compile_s = time.perf_counter() - t
+    ref = einsum_attention_reference(q, k, v)
+    err = float(jnp.max(jnp.abs(o - ref)))
+    t = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        o = flash_attention(q, k, v)
+    o.block_until_ready()
+    us = (time.perf_counter() - t) / reps * 1e6
+    from perceiver_tpu.utils.platform import is_tpu_platform
+
+    _out({"stage": "pallas", "kernel": "flash_attention",
+          "shape": [b, h, lq, lk, d], "compile_s": round(compile_s, 1),
+          "max_abs_err_vs_einsum": round(err, 6),
+          "us_per_call": round(us, 1), "platform": dev.platform,
+          # plugin TPU backends report platform "axon", not "tpu" —
+          # is_tpu_platform is what flash_attention itself consults to
+          # select the real (Mosaic) kernel over interpret mode
+          "mosaic": is_tpu_platform(dev.platform)})
+
+
+if __name__ == "__main__":
+    main()

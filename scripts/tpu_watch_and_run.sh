@@ -84,6 +84,9 @@ while true; do
 
   # Priority order, smallest/fastest first. || continue goes back to
   # probing as soon as a step fails so we do not burn a dead tunnel.
+  # hello: ~30 s — device proof + XLA matmul TFLOP/s + ONE
+  # Mosaic-compiled Pallas kernel, each flushed as its own JSON line
+  step hello        300  120 python scripts/tpu_hello.py || continue
   step bench_b64    480  240 env BENCH_WAIT=0 BENCH_BATCH=64  BENCH_INNER_STEPS=1 BENCH_LOSS_IMPL=packed python bench.py || continue
   step bench_b256   600  240 env BENCH_WAIT=0 BENCH_BATCH=256 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
   step bench_b512   720  300 env BENCH_WAIT=0 BENCH_BATCH=512 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
