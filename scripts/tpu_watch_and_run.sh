@@ -86,7 +86,10 @@ while true; do
   # probing as soon as a step fails so we do not burn a dead tunnel.
   # hello: ~30 s — device proof + XLA matmul TFLOP/s + ONE
   # Mosaic-compiled Pallas kernel, each flushed as its own JSON line
-  step hello        300  120 python scripts/tpu_hello.py || continue
+  # hello is extra evidence, not a gate: a persistent hello-specific
+  # failure must not lock out the bench/kernel/quality steps (its
+  # partial JSON lines are already on disk either way)
+  step hello        300  120 python scripts/tpu_hello.py || true
   step bench_b64    480  240 env BENCH_WAIT=0 BENCH_BATCH=64  BENCH_INNER_STEPS=1 BENCH_LOSS_IMPL=packed python bench.py || continue
   step bench_b256   600  240 env BENCH_WAIT=0 BENCH_BATCH=256 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
   step bench_b512   720  300 env BENCH_WAIT=0 BENCH_BATCH=512 BENCH_INNER_STEPS=8 BENCH_LOSS_IMPL=packed python bench.py || continue
