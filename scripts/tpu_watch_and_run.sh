@@ -40,11 +40,26 @@ step() {
   if [[ -e "$OUT/$label.done" ]]; then
     return 0  # already collected in an earlier window
   fi
+  if driver_bench_active; then
+    say "step $label: driver bench active — deferring"
+    return 1  # || continue sends the main loop back to standby
+  fi
   say "step $label: $*"
   ( "$@" ) > "$OUT/$label.out" 2> "$OUT/$label.err" &
   local pid=$! t_start=$SECONDS last_size=-1 last_change=$SECONDS
   while kill -0 "$pid" 2>/dev/null; do
     sleep 15
+    if driver_bench_active; then
+      # the driver's bench needs the chip NOW — SIGTERM first (the
+      # quality run preempt-saves on it), escalate if it lingers
+      say "step $label: driver bench became active — yielding the chip"
+      kill "$pid" 2>/dev/null
+      for _ in 1 2 3 4 5 6 7 8; do
+        sleep 10
+        kill -0 "$pid" 2>/dev/null || break
+      done
+      kill -9 "$pid" 2>/dev/null
+    fi
     local now=$SECONDS size
     size=$(( $(stat -c %s "$OUT/$label.err" 2>/dev/null || echo 0) +
              $(stat -c %s "$OUT/$label.out" 2>/dev/null || echo 0) ))
@@ -73,8 +88,28 @@ step() {
 
 . scripts/lib_ckpt.sh  # furthest_ckpt + mlm_quality_ckpt_globs
 
+# The driver's end-of-round bench (bench.py supervisor) marks itself
+# active so the watcher does not steal the chip from it — the TPU
+# runtime admits one process. A marker older than 4 h is a crashed
+# supervisor, not an active one.
+driver_bench_active() {
+  local m="$OUT/.driver_bench_active"
+  [[ -e "$m" ]] || return 1
+  local age=$(( $(date +%s) - $(stat -c %Y "$m" 2>/dev/null || echo 0) ))
+  if (( age > 14400 )); then
+    rm -f "$m"
+    return 1
+  fi
+  return 0
+}
+
 say "watcher started (pid $$)"
 while true; do
+  if driver_bench_active; then
+    say "driver bench active — standing down"
+    sleep 150
+    continue
+  fi
   if ! probe; then
     say "probe: backend down"
     sleep 150

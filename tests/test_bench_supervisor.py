@@ -110,6 +110,42 @@ def test_supervisor_disables_own_watchdog(bench, monkeypatch):
     assert seen == [0]  # disabled before the child ran
 
 
+def test_supervisor_pause_marker_lifecycle(bench, monkeypatch, tmp_path):
+    # The watcher stands down while the .driver_bench_active marker
+    # exists (one process owns the TPU) — the supervisor must create it
+    # for its whole wait and remove it on every exit path. Path is
+    # injectable so the test never touches the production marker a
+    # live supervisor may be relying on.
+    marker = str(tmp_path / ".driver_bench_active")
+    monkeypatch.setenv("BENCH_PAUSE_MARKER", marker)
+    seen = []
+    monkeypatch.setattr(bench, "_exec_probe",
+                        lambda *a, **k: seen.append(os.path.exists(marker))
+                        is None and False)
+    monkeypatch.setenv("BENCH_WAIT", "0.2")
+    monkeypatch.setenv("BENCH_PROBE_INTERVAL", "0.05")
+    assert bench.supervise() == 4
+    assert seen and all(seen)  # marker present during probing
+    assert not os.path.exists(marker)  # removed on exit
+
+
+def test_supervisor_leaves_foreign_marker(bench, monkeypatch, tmp_path):
+    # finally must not strip a LIVE concurrent supervisor's marker:
+    # unlink only when the marker still holds our own pid.
+    marker = tmp_path / ".driver_bench_active"
+    monkeypatch.setenv("BENCH_PAUSE_MARKER", str(marker))
+    monkeypatch.setenv("BENCH_WAIT", "60")
+    monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
+
+    def fake_call(cmd, env=None):
+        marker.write_text("999999")  # another instance took over
+        return 0
+
+    monkeypatch.setattr(bench.subprocess, "call", fake_call)
+    assert bench.supervise() == 0
+    assert marker.read_text() == "999999"  # foreign marker untouched
+
+
 def test_cpu_smoke_skips_supervisor(bench, monkeypatch):
     # BENCH_PLATFORM=cpu (smoke runs, sweeps) must go straight to the
     # ladder — probing for a TPU would always fail and eat BENCH_WAIT.
