@@ -405,9 +405,13 @@ def supervise() -> int:
         while True:
             if pause_marker:
                 try:
-                    # keep the mtime fresh: the watcher treats a
-                    # marker older than 4 h as a crashed supervisor
-                    os.utime(pause_marker)
+                    # re-WRITE (not just utime) every loop: the marker
+                    # must come back even if a concurrent supervisor's
+                    # exit or the watcher's stale-marker sweep deleted
+                    # it — losing it permanently would hand the chip
+                    # to the watcher for the rest of the wait budget
+                    with open(pause_marker, "w") as f:
+                        f.write(str(os.getpid()))
                 except OSError:
                     pass
             t_probe = time.monotonic()

@@ -113,6 +113,33 @@ def main():
           # select the real (Mosaic) kernel over interpret mode
           "mosaic": is_tpu_platform(dev.platform)})
 
+    # -- and the second kernel: the fused vocab-CE -----------------------
+    _arm(180)
+    from perceiver_tpu.ops.fused_ce import fused_linear_cross_entropy
+    from perceiver_tpu.ops.linear import linear_init
+    from perceiver_tpu.ops.pallas_ce import pallas_linear_cross_entropy
+    from perceiver_tpu.ops.policy import Policy
+
+    n, c, vocab = 1024, 64, 10003
+    pol = Policy.fp32()
+    lp = linear_init(jax.random.key(1), c, vocab)
+    hid = jax.random.normal(jax.random.key(2), (n, c), jnp.float32)
+    lab = jax.random.randint(jax.random.key(3), (n,), 0, vocab)
+    wgt = (jax.random.uniform(jax.random.key(4), (n,)) < 0.15).astype(
+        jnp.float32)
+    t = time.perf_counter()
+    loss = pallas_linear_cross_entropy(lp, hid, lab, wgt, policy=pol)
+    loss.block_until_ready()
+    compile_s = time.perf_counter() - t
+    ref = fused_linear_cross_entropy(lp, hid, lab, wgt, chunk_size=256,
+                                     policy=pol)
+    _out({"stage": "pallas_ce", "kernel": "pallas_linear_cross_entropy",
+          "shape": [n, c, vocab], "compile_s": round(compile_s, 1),
+          "loss": round(float(loss), 6),
+          "abs_err_vs_fused": round(abs(float(loss) - float(ref)), 6),
+          "platform": dev.platform,
+          "mosaic": is_tpu_platform(dev.platform)})
+
 
 if __name__ == "__main__":
     main()

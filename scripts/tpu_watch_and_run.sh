@@ -140,14 +140,25 @@ while true; do
   # windows via mlm_quality_run.sh's newest-checkpoint lookup), then
   # the two-phase seq_clf transfer on its best checkpoint
   step mlm_quality 14400 900 bash scripts/mlm_quality_run.sh 50000 || continue
-  step clf_phase1  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache \
+  # transfer proof on the COHERENCE labels (the round-3 evidence task:
+  # BoW-at-chance, so the win measures representations, not keywords).
+  # The corpus build is a STEP (rc checked, .done sentinel) so an
+  # interrupted build can never masquerade as a complete corpus; fresh
+  # labels deliberately use new names — stale clf_phase*.done files
+  # from the pre-coherence label scheme must not skip these.
+  step coh_corpus   600  300 python scripts/make_coherence_corpus.py \
+      --half-chars 400 || continue
+  step coh_phase1  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh \
       --model.mlm_ckpt="$(furthest_ckpt $(mlm_quality_ckpt_globs))" \
       --model.freeze_encoder=true --trainer.max_steps=3000 \
-      --trainer.steps_per_execution=8 --experiment=clf_tpu_phase1 || continue
-  step clf_phase2  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache \
-      --model.clf_ckpt="$(furthest_ckpt logs/clf_tpu_phase1/version_*/checkpoints*)" \
+      --trainer.steps_per_execution=8 --experiment=coh_tpu_phase1 || continue
+  step coh_phase2  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh \
+      --model.clf_ckpt="$(furthest_ckpt logs/coh_tpu_phase1/version_*/checkpoints*)" \
       --optimizer.init_args.lr=0.0001 --trainer.max_steps=1500 \
-      --trainer.steps_per_execution=8 --experiment=clf_tpu_phase2 || continue
+      --trainer.steps_per_execution=8 --experiment=coh_tpu_phase2 || continue
+  step coh_scratch 3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh \
+      --trainer.max_steps=4500 --trainer.steps_per_execution=8 \
+      --experiment=coh_tpu_scratch || continue
   say "ALL EVIDENCE COLLECTED"
   break
 done
