@@ -347,10 +347,28 @@ want = os.environ.get("BENCH_PLATFORM")
 if want:
     jax.config.update("jax_platforms", want)
 d = jax.devices()
-assert d[0].platform == "tpu", d
+# TPU-platform aliases, inlined (the probe must stay self-contained —
+# importing the package would make any unrelated import error look
+# like a dead tunnel) but honoring the same extension env var as
+# perceiver_tpu/utils/platform.py: the axon tunnel plugin reports
+# platform "axon", not "tpu"
+aliases = ("tpu", "axon") + tuple(
+    a.strip()
+    for a in os.environ.get("PERCEIVER_TPU_PLATFORM_ALIASES", "").split(",")
+    if a.strip())
+assert d[0].platform in aliases, d
 x = jnp.ones((512, 512), jnp.bfloat16)
 (x @ x).block_until_ready()
 """
+
+
+def _tpu_aliases() -> tuple:
+    # mirrors perceiver_tpu.utils.platform.tpu_platform_names without
+    # importing the package (bench.py must work from any cwd before
+    # the heavy imports)
+    extra = os.environ.get("PERCEIVER_TPU_PLATFORM_ALIASES", "")
+    return ("tpu", "axon") + tuple(
+        a.strip() for a in extra.split(",") if a.strip())
 
 
 def _exec_probe(timeout: float = 90.0) -> bool:
@@ -464,10 +482,11 @@ def supervise() -> int:
 
 def main():
     # Supervisor mode: only for a real-TPU target (BENCH_PLATFORM unset
-    # or tpu) with a nonzero wait budget. CPU smoke runs, sweeps, and
-    # the supervisor's own children (BENCH_WAIT=0) run directly.
+    # or a TPU-class platform, incl. the axon plugin) with a nonzero
+    # wait budget. CPU smoke runs, sweeps, and the supervisor's own
+    # children (BENCH_WAIT=0) run directly.
     if (float(os.environ.get("BENCH_WAIT", "7200")) > 0
-            and os.environ.get("BENCH_PLATFORM", "tpu") == "tpu"):
+            and os.environ.get("BENCH_PLATFORM", "tpu") in _tpu_aliases()):
         raise SystemExit(supervise())
 
     pinned = any(k in os.environ for k in

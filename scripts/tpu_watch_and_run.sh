@@ -20,11 +20,21 @@ ts() { date -u +%FT%TZ; }
 say() { echo "[$(ts)] $*" >> "$LOG"; }
 
 probe() {
-  # success = backend initializes AND executes a matmul, within 90 s
+  # success = backend initializes AND executes a matmul, within 90 s.
+  # The axon tunnel plugin reports platform "axon", not "tpu" — a bare
+  # == "tpu" assert would reject a LIVE tunnel forever. Aliases are
+  # INLINED (mirroring utils/platform.py incl. its env extension) so
+  # the probe stays a pure tunnel-health check: importing the package
+  # here would make any unrelated import error look like a dead
+  # tunnel, silently, forever.
   timeout 90 python - <<'EOF' > /dev/null 2>&1
-import jax, jax.numpy as jnp
+import os, jax, jax.numpy as jnp
 d = jax.devices()
-assert d[0].platform in ("tpu",), d
+aliases = ("tpu", "axon") + tuple(
+    a.strip()
+    for a in os.environ.get("PERCEIVER_TPU_PLATFORM_ALIASES", "").split(",")
+    if a.strip())
+assert d[0].platform in aliases, d
 x = jnp.ones((512, 512), jnp.bfloat16)
 (x @ x).block_until_ready()
 EOF
