@@ -341,39 +341,36 @@ def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
 # supervisor's timeout. Success requires the matmul to EXECUTE (the
 # 2026-07-31 failure mode initialized + compiled fine, then hung on the
 # first dispatch).
+def _tpu_aliases() -> tuple:
+    # mirrors perceiver_tpu.utils.platform.tpu_platform_names without
+    # importing the package (bench.py must work from any cwd before
+    # the heavy imports); the axon tunnel plugin reports platform
+    # "axon", not "tpu"
+    extra = os.environ.get("PERCEIVER_TPU_PLATFORM_ALIASES", "")
+    return ("tpu", "axon") + tuple(
+        a.strip() for a in extra.split(",") if a.strip())
+
+
+# The alias tuple is interpolated at probe-launch time so the probe
+# source stays self-contained (importing the package in the probe
+# would make any unrelated import error look like a dead tunnel)
+# while keeping a single alias definition in this file.
 _PROBE_SRC = """
 import os, jax, jax.numpy as jnp
 want = os.environ.get("BENCH_PLATFORM")
 if want:
     jax.config.update("jax_platforms", want)
 d = jax.devices()
-# TPU-platform aliases, inlined (the probe must stay self-contained —
-# importing the package would make any unrelated import error look
-# like a dead tunnel) but honoring the same extension env var as
-# perceiver_tpu/utils/platform.py: the axon tunnel plugin reports
-# platform "axon", not "tpu"
-aliases = ("tpu", "axon") + tuple(
-    a.strip()
-    for a in os.environ.get("PERCEIVER_TPU_PLATFORM_ALIASES", "").split(",")
-    if a.strip())
-assert d[0].platform in aliases, d
+assert d[0].platform in {aliases!r}, d
 x = jnp.ones((512, 512), jnp.bfloat16)
 (x @ x).block_until_ready()
 """
 
 
-def _tpu_aliases() -> tuple:
-    # mirrors perceiver_tpu.utils.platform.tpu_platform_names without
-    # importing the package (bench.py must work from any cwd before
-    # the heavy imports)
-    extra = os.environ.get("PERCEIVER_TPU_PLATFORM_ALIASES", "")
-    return ("tpu", "axon") + tuple(
-        a.strip() for a in extra.split(",") if a.strip())
-
-
 def _exec_probe(timeout: float = 90.0) -> bool:
     try:
-        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+        src = _PROBE_SRC.format(aliases=_tpu_aliases())
+        r = subprocess.run([sys.executable, "-c", src],
                            stdout=subprocess.DEVNULL,
                            stderr=subprocess.DEVNULL, timeout=timeout)
         return r.returncode == 0

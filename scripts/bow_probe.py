@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Bag-of-words control for the coherence corpus (VERDICT r2 #4).
+
+The transfer-wins claim in QUALITY_r03_coherence.json rests on the
+coherence labels NOT being solvable by surface lexical statistics (the
+round-2 API-vs-prose labels were, which let scratch beat transfer).
+This probe trains a hashed bag-of-words logistic regression — the
+strongest pure-keyword model — on the corpus; at-chance accuracy is
+the certificate that the label needs language understanding.
+
+Usage: python scripts/bow_probe.py [--data .cache_coh]  → one JSON line
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import zlib
+
+import numpy as np
+
+D = 2 ** 15  # hashed vocab dim
+
+
+def load(root: str, split: str):
+    texts, y = [], []
+    for label, yy in (("neg", 0), ("pos", 1)):
+        for p in sorted(glob.glob(os.path.join(root, "aclImdb", split,
+                                               label, "*.txt"))):
+            with open(p, encoding="utf-8") as f:
+                texts.append(f.read())
+            y.append(yy)
+    return texts, np.asarray(y)
+
+
+def featurize(texts):
+    m = np.zeros((len(texts), D), np.float32)
+    for i, t in enumerate(texts):
+        for w in re.findall(r"[a-z]+", t.lower()):
+            # crc32: process-stable (python's hash() is salted)
+            m[i, zlib.crc32(w.encode()) % D] += 1.0
+        n = m[i].sum()
+        if n:
+            m[i] /= n
+    return m
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=".cache_coh")
+    ap.add_argument("--limit-train", type=int, default=0,
+                    help="subset the train set to N examples "
+                         "(balanced, seed 0) — the few-shot control")
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=0.5)
+    args = ap.parse_args()
+
+    xtr, ytr = load(args.data, "train")
+    xte, yte = load(args.data, "test")
+    if not len(ytr) or not len(yte):
+        sys.exit(f"no corpus at {args.data}/aclImdb — an empty probe "
+                 "result would be a meaningless certificate")
+    if args.limit_train and args.limit_train < len(ytr):
+        rng = np.random.default_rng(0)
+        keep = np.concatenate([
+            rng.permutation(np.flatnonzero(ytr == c))[:args.limit_train // 2]
+            for c in (0, 1)])
+        xtr = [xtr[i] for i in keep]
+        ytr = ytr[keep]
+    ftr, fte = featurize(xtr), featurize(xte)
+
+    rng = np.random.default_rng(0)
+    w = np.zeros(D, np.float32)
+    b = 0.0
+    idx = np.arange(len(ytr))
+    for _ in range(args.epochs):
+        rng.shuffle(idx)
+        for s in range(0, len(idx), 64):
+            j = idx[s:s + 64]
+            p = 1.0 / (1.0 + np.exp(-(ftr[j] @ w + b)))
+            g = p - ytr[j]
+            w -= args.lr * (ftr[j].T @ g) / len(j)
+            b -= args.lr * g.mean()
+
+    out = {
+        "probe": "hashed-BoW logistic regression",
+        "dim": D,
+        "data": args.data,
+        "n_train": len(ytr),
+        "n_test": len(yte),
+        "train_acc": round(float((((ftr @ w + b) > 0) == ytr).mean()), 4),
+        "test_acc": round(float((((fte @ w + b) > 0) == yte).mean()), 4),
+        "chance": 0.5,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,33 @@ run coh_phase2_lr0.001 --data.data_dir=.cache_coh \
     --trainer.max_steps=300
 
 # --- few-shot regime: 512 labeled examples, same 246-example test ----
+# subset corpus is derived deterministically (seed 0) from .cache_coh;
+# build it here so the fs_* arms are reproducible from a fresh checkout
+if [[ ! -d .cache_coh_small/aclImdb ]]; then
+  python - <<'EOF'
+import glob, os, random, shutil
+random.seed(0)
+src, dst = ".cache_coh", ".cache_coh_small"
+shutil.rmtree(dst, ignore_errors=True)
+for label in ("neg", "pos"):
+    files = sorted(glob.glob(f"{src}/aclImdb/train/{label}/*.txt"))
+    random.shuffle(files)
+    d = f"{dst}/aclImdb/train/{label}"
+    os.makedirs(d)
+    for f in files[:256]:
+        shutil.copy(f, d)
+for label in ("neg", "pos"):
+    d = f"{dst}/aclImdb/test/{label}"
+    os.makedirs(d)
+    for f in glob.glob(f"{src}/aclImdb/test/{label}/*.txt"):
+        shutil.copy(f, d)
+for tok in glob.glob(f"{src}/imdb-tokenizer-*.json"):
+    shutil.copy(tok, dst)
+print("built .cache_coh_small:",
+      len(glob.glob(f"{dst}/aclImdb/train/*/*.txt")), "train /",
+      len(glob.glob(f"{dst}/aclImdb/test/*/*.txt")), "test")
+EOF
+fi
 FS=(--data.data_dir=.cache_coh_small)
 run fs_frozen_random "${FS[@]}" --model.freeze_encoder=true \
     --trainer.max_steps=300
