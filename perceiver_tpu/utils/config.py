@@ -347,6 +347,14 @@ class CLI:
                     "predict requires --model.masked_samples")
         task, datamodule, trainer = self.instantiate()
         self.trainer = trainer
+        # config snapshot BEFORE running (reference cli.py:22
+        # SaveConfigCallback writes at setup): a preempted / killed /
+        # still-running fit must still leave its config.yaml — the
+        # platform-labeling of evidence (quality_summary.py) and any
+        # post-mortem read it from the version dir
+        os.makedirs(trainer.log_dir, exist_ok=True)
+        with open(os.path.join(trainer.log_dir, "config.yaml"), "w") as f:
+            yaml.safe_dump(self.config, f, sort_keys=True)
         if self.subcommand == "fit":
             state = trainer.fit()
         else:
@@ -367,10 +375,6 @@ class CLI:
                 result = trainer.task.predict(trainer, state)
             print(yaml.safe_dump(result, sort_keys=True,
                                  allow_unicode=True))
-        # config snapshot (reference cli.py:22 save_config_overwrite)
-        os.makedirs(trainer.log_dir, exist_ok=True)
-        with open(os.path.join(trainer.log_dir, "config.yaml"), "w") as f:
-            yaml.safe_dump(self.config, f, sort_keys=True)
         return state if self.subcommand == "fit" else result
 
     def _print_help(self):

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Consolidated round-3 quality evidence → QUALITY_r03.json:
+# the MLM pretraining curve (all quality experiment dirs, furthest
+# first), plus pointers to the coherence-transfer table and the BoW
+# unlearnability certificate. Rerunnable; run once more right before
+# round end to capture the latest val point.
+set -u
+cd "$(dirname "$0")/.."
+
+python - <<'EOF' > QUALITY_r03.json
+import json, subprocess, sys
+
+def summary(*exps):
+    out = subprocess.run(
+        [sys.executable, "scripts/quality_summary.py", *exps],
+        capture_output=True, text=True)
+    lines = out.stdout.splitlines()
+    start = next((i for i, l in enumerate(lines) if l.startswith("{")),
+                 None)
+    if out.returncode != 0 or start is None:
+        # an empty mlm_pretraining section silently masquerading as
+        # evidence is worse than a loud failure
+        sys.stderr.write(out.stderr)
+        sys.exit(f"quality_summary failed (rc={out.returncode}) for "
+                 f"{exps}")
+    return json.loads("\n".join(lines[start:]))
+
+doc = {
+    "round": 3,
+    "note": ("Axon tunnel down for the entire round (watch.log); all "
+             "numbers CPU — the on-chip evidence chain is scripted in "
+             "scripts/tpu_watch_and_run.sh and collects automatically "
+             "the moment a window opens."),
+    "mlm_pretraining": summary("mlm_quality", "mlm_cpu_quality"),
+    "coherence_transfer": "see QUALITY_r03_coherence.json (14 arms)",
+    "bow_control": "see QUALITY_r03_bow_control.json (at-chance)",
+}
+json.dump(doc, sys.stdout, indent=1)
+EOF
+echo "" >> QUALITY_r03.json
+python -c "import json; d=json.load(open('QUALITY_r03.json')); \
+print('QUALITY_r03.json ok:', list(d))"

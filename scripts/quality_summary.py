@@ -33,6 +33,16 @@ def run_platform(version_dir: str):
                     return line.split(":", 1)[1].strip()
     except OSError:
         pass
+    # runs preempted before the snapshot existed still carry the
+    # trainer config in the checkpoint hook's hparams.json
+    for sub in ("checkpoints", "checkpoints-preempt"):
+        try:
+            with open(os.path.join(version_dir, sub, "hparams.json")) as f:
+                acc = json.load(f).get("trainer", {}).get("accelerator")
+                if acc:
+                    return acc
+        except (OSError, ValueError):
+            continue
     return "unknown"
 
 

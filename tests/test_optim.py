@@ -161,3 +161,39 @@ def test_mlm_cli_defaults_onecycle():
               "--lr_scheduler.init_args.T_max=100"], run=False)
     ia = cli3.config["lr_scheduler"].get("init_args", {})
     assert "total_steps" not in ia and "max_lr" not in ia
+
+
+def test_config_snapshot_written_before_fit(tmp_path, monkeypatch):
+    """The config.yaml snapshot must exist BEFORE training runs
+    (reference SaveConfigCallback timing): a preempted/killed run's
+    version dir still identifies its accelerator and hparams — the
+    platform-labeling of evidence (quality_summary.py) depends on it."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import img_clf as img_script
+
+    from perceiver_tpu.training.trainer import Trainer
+
+    seen = {}
+
+    def boom(self):
+        seen["snapshot_exists"] = os.path.exists(
+            os.path.join(self.log_dir, "config.yaml"))
+        raise RuntimeError("simulated mid-fit kill")
+
+    monkeypatch.setattr(Trainer, "fit", boom)
+    cli = img_script.main(
+        args=["fit", "--data=SyntheticImageDataModule",
+              "--data.train_size=8", "--data.val_size=8",
+              "--data.test_size=8", "--data.batch_size=4",
+              "--data.image_shape=[8,8,1]", "--data.num_classes=3",
+              "--trainer.fast_dev_run=true", "--trainer.accelerator=cpu",
+              f"--trainer.default_root_dir={tmp_path}"],
+        run=False)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="simulated mid-fit kill"):
+        cli.run()
+    assert seen.get("snapshot_exists") is True
