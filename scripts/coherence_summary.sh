@@ -11,6 +11,7 @@ ARMS=(coh_frozen_random coh_phase1 coh_phase2 coh_phase2_lr0.0003
       coh_phase2_lr0.001 coh_scratch coh_scratch_lr0.0003
       coh_scratch_lr0.0001 fs_frozen_random fs_phase1 fs_phase2
       fs_phase2_lr0.0003 fs_scratch_lr0.0001 fs_scratch_lr0.0003
+      fs_phase1_seed1 fs_phase2_seed1 fs_scratch_seed1
       coh_tpu_phase1 coh_tpu_phase2 coh_tpu_scratch)
 have=()
 for a in "${ARMS[@]}"; do
