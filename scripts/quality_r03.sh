@@ -32,6 +32,15 @@ doc = {
              "scripts/tpu_watch_and_run.sh and collects automatically "
              "the moment a window opens."),
     "mlm_pretraining": summary("mlm_quality", "mlm_cpu_quality"),
+    # the `validate` verb prints metrics but writes no TB events; the
+    # round-3 closing number is recorded here (reproduce with:
+    # python scripts/mlm.py validate --data.data_dir=.cache
+    #   --trainer.accelerator=cpu
+    #   --ckpt_path=logs/mlm_quality/version_0/checkpoints-preempt)
+    "mlm_final_validate": {"step": 11505, "val_loss": 4.9692,
+                           "platform": "cpu",
+                           "ckpt": "logs/mlm_quality/version_0/"
+                                   "checkpoints-preempt"},
     "coherence_transfer": "see QUALITY_r03_coherence.json (14 arms)",
     "bow_control": "see QUALITY_r03_bow_control.json (at-chance)",
 }
