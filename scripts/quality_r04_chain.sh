@@ -1,0 +1,85 @@
+#!/bin/bash
+# Round-4 quality chain (VERDICT r3 next-round items #5 and #6):
+#
+#   1. wait for the in-flight 14k-step MLM quality run to finish its
+#      OneCycle schedule (it was launched at round-3 wrap and survives
+#      the round boundary), then record the FINAL validate number —
+#      a completed schedule, not a still-falling snapshot;
+#   2. multi-seed (0,1,2) the full-label coherence arms on the
+#      round-4 corpus (.cache_coh4: val split 682 >= 500, BoW probe
+#      at chance — QUALITY_r04_bow_control.json), scratch-tuned vs
+#      transfer-tuned with scratch getting BOTH of its round-3 best
+#      lrs per seed (generous-to-scratch symmetric tuning);
+#   3. write QUALITY_r04_coherence.json.
+#
+# Lean core first (phase1/phase2/scratch-1e-4 for every seed), extra
+# scratch-3e-4 arms after — a round-end kill still leaves a complete
+# 3-seed comparison. Resumable via the same .done sentinels as the
+# round-3 chains.
+set -u
+cd "$(dirname "$0")/.."
+. scripts/lib_ckpt.sh
+
+MLM_PAT="scripts/mlm.py fit.*experiment=mlm_quality"
+if pgrep -f "$MLM_PAT" > /dev/null 2>&1; then
+  echo "== waiting for the 14k MLM run to finish: $(date -u +%FT%TZ)"
+  while pgrep -f "$MLM_PAT" > /dev/null 2>&1; do sleep 60; done
+  echo "== MLM run exited: $(date -u +%FT%TZ)"
+fi
+
+MLM_CKPT=$(furthest_ckpt $(mlm_quality_ckpt_globs))
+[[ -d "$MLM_CKPT" ]] || { echo "no MLM checkpoint"; exit 1; }
+echo "== MLM checkpoint: $MLM_CKPT"
+
+if [[ ! -e logs/mlm_final_validate_r04.done ]]; then
+  echo "== final validate on $MLM_CKPT: $(date -u +%FT%TZ)"
+  python scripts/mlm.py validate --data.data_dir=.cache \
+    --trainer.accelerator=cpu --experiment=mlm_quality_finalval_r04 \
+    --ckpt_path="$MLM_CKPT" > logs/mlm_final_validate_r04.log 2>&1 \
+    && touch logs/mlm_final_validate_r04.done
+  tail -3 logs/mlm_final_validate_r04.log
+fi
+
+COMMON=(--data.data_dir=.cache_coh4 --data.batch_size=32
+        --trainer.log_every_n_steps=50 --trainer.accelerator=cpu)
+
+run() {
+  local name=$1; shift
+  if [[ -e "logs/$name.done" ]]; then
+    echo "== $name already complete — skipping"
+    return 0
+  fi
+  echo "== $name: $(date -u +%FT%TZ)"
+  python scripts/seq_clf.py fit "${COMMON[@]}" --experiment="$name" "$@" \
+    > "logs/$name.log" 2>&1
+  local rc=$?
+  echo "== $name done rc=$rc $(date -u +%FT%TZ)"
+  if (( rc != 0 )); then
+    echo "== $name FAILED — aborting (see logs/$name.log)"
+    exit "$rc"
+  fi
+  touch "logs/$name.done"
+}
+
+# --- lean core: every seed gets phase1 -> phase2(tuned 3e-4) and
+# --- scratch at its round-3-best lr 1e-4, equal total budget ---------
+for s in 0 1 2; do
+  run "coh4_phase1_s$s" --trainer.seed=$s --model.freeze_encoder=true \
+      --model.mlm_ckpt="$MLM_CKPT" --trainer.max_steps=300
+  PH1=$(furthest_ckpt "logs/coh4_phase1_s$s"/version_*/checkpoints*)
+  [[ -d "$PH1" ]] || { echo "no phase-1 ckpt for seed $s"; exit 1; }
+  run "coh4_phase2_s$s" --trainer.seed=$s --model.clf_ckpt="$PH1" \
+      --optimizer.init_args.lr=0.0003 --trainer.max_steps=300
+  run "coh4_scratch_lr1e-4_s$s" --trainer.seed=$s \
+      --optimizer.init_args.lr=0.0001 --trainer.max_steps=600
+  bash scripts/quality_r04_coherence_summary.sh || true
+done
+
+# --- generous-to-scratch second lr arm, per seed ---------------------
+for s in 0 1 2; do
+  run "coh4_scratch_lr3e-4_s$s" --trainer.seed=$s \
+      --optimizer.init_args.lr=0.0003 --trainer.max_steps=600
+  bash scripts/quality_r04_coherence_summary.sh || true
+done
+
+echo "== chain complete: $(date -u +%FT%TZ)"

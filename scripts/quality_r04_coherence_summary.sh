@@ -1,0 +1,20 @@
+#!/bin/bash
+# Regenerate QUALITY_r04_coherence.json from every round-4 coherence
+# arm that has produced events — single writer, rerunnable mid-chain
+# (called after each completed arm so a round-end kill still leaves a
+# current summary).
+set -u
+cd "$(dirname "$0")/.."
+
+ARMS=()
+for s in 0 1 2; do
+  ARMS+=("coh4_phase1_s$s" "coh4_phase2_s$s"
+         "coh4_scratch_lr1e-4_s$s" "coh4_scratch_lr3e-4_s$s")
+done
+have=()
+for a in "${ARMS[@]}"; do
+  ls "logs/$a"/version_*/events.* > /dev/null 2>&1 && have+=("$a")
+done
+(( ${#have[@]} > 0 )) || { echo "no round-4 coherence arms yet"; exit 1; }
+python scripts/quality_summary.py "${have[@]}" > QUALITY_r04_coherence.json
+echo "QUALITY_r04_coherence.json: ${#have[@]} arms"
