@@ -6,12 +6,15 @@ pretraining at seq_len=512 with the reference model config (64×64
 latents, 3 encoder layers, 6 self-attn layers/block, vocab 10003) —
 on full jitted train steps (forward + backward + AdamW update) in
 bf16, with the packed fused-CE loss path and several optimizer steps
-per dispatch (lax.scan). Prints ONE JSON line.
+per dispatch (lax.scan). Prints JSON result lines to stdout, one per
+completed config, later lines superseding earlier — the final line is
+the one the driver should record.
 
 Config comes from BENCH_BATCH / BENCH_INNER_STEPS / BENCH_LOSS_IMPL
 when set (pinned exactly — sweeps rely on that); otherwise a ladder of
-configs is tried from most to least aggressive, so an OOM or compile
-failure on a given chip degrades the number instead of producing none.
+configs is climbed smallest-first, each completed rung flushed
+immediately, so an OOM, compile failure, or kill at any point leaves
+every number collected so far instead of none.
 
 ``BENCH_TASK=img_clf`` switches to the secondary BASELINE.md metric:
 MNIST imgs/sec/chip with the ``scripts/img_clf.py`` model config
@@ -21,12 +24,29 @@ MNIST imgs/sec/chip with the ``scripts/img_clf.py`` model config
 (BASELINE.json "published": {}).
 
 For a real-TPU target the bench runs under a SUPERVISOR (``BENCH_WAIT``
-seconds of probe-retry budget, default 7200; ``BENCH_PROBE_INTERVAL``
+seconds of probe-retry budget, default 1350; ``BENCH_PROBE_INTERVAL``
 between probes, default 120): the axon tunnel's availability windows
 are short and rare, so instead of failing on the first dead probe the
 supervisor keeps execution-probing in a subprocess and launches the
 actual bench the moment a probe matmul completes. ``BENCH_WAIT=0``
 (or ``BENCH_PLATFORM=cpu``) runs the ladder directly.
+
+Driver contract (VERDICT r3 weak #1 — the bench must be un-failable):
+the driver hard-kills ``python bench.py`` at ~1800 s and parses stdout
+for a JSON result line, so
+
+  * ``BENCH_WAIT`` defaults INSIDE that budget (1350 s), leaving room
+    for a started-late attempt and the final status line;
+  * the supervisor flushes a structured status JSON line (same
+    metric/value/unit/vs_baseline schema, ``"measured": false``,
+    ``value`` 0.0 as an explicit sentinel) after every failed probe —
+    a tail-only or last-line parse always finds a parseable object no
+    matter when the kill lands;
+  * an unpinned ladder runs SMALLEST config first and flushes each
+    config's result the moment it completes, so a mid-ladder death
+    still records the numbers collected so far (later lines supersede
+    earlier ones; the supervisor re-emits the best-throughput result
+    as the final line).
 """
 
 import json
@@ -51,6 +71,19 @@ _LADDER = [
     (64, 1, "packed"),
     (64, 1, "dense"),
 ]
+
+# Default probe-retry budget, seconds. MUST stay inside the driver's
+# observed ~1800 s hard-kill window (BENCH_r03.json: rc=124, capture
+# stops at +1770 s) with room for a final status line.
+_DEFAULT_WAIT = "1350"
+
+# What the sentinel status line reports when no measurement exists yet
+# (keyed by BENCH_TASK; must match the metric the runner would emit).
+_TASK_METRIC = {
+    "": ("imdb_mlm_tokens_per_sec_per_chip", "tokens/s"),
+    "img_clf": ("mnist_imgs_per_sec_per_chip", "imgs/s"),
+    "seg": ("lartpc_seg_pixels_per_sec_per_chip", "pixels/s"),
+}
 
 
 def _log(msg: str) -> None:
@@ -378,6 +411,86 @@ def _exec_probe(timeout: float = 90.0) -> bool:
         return False
 
 
+def _emit_status(verdict: str, *, probes_failed: int, attempts: int,
+                 results: list) -> None:
+    """Flush one structured JSON line to stdout describing the current
+    supervisor state. Same schema as a measurement (metric/value/unit/
+    vs_baseline) so the driver's parse always succeeds; ``measured``
+    distinguishes a sentinel from a real number, and later lines
+    supersede earlier ones. If any config HAS completed, the best
+    throughput seen so far is re-emitted instead of a zero sentinel —
+    a driver kill at any moment records the best number collected."""
+    if results:
+        best = max(results, key=lambda r: r.get("value") or 0)
+        obj = dict(best)
+    else:
+        metric, unit = _TASK_METRIC.get(
+            os.environ.get("BENCH_TASK", ""), _TASK_METRIC[""])
+        obj = {"metric": metric, "value": 0.0, "unit": unit,
+               "vs_baseline": None, "measured": False,
+               "note": ("value 0.0 is a SENTINEL (no measurement "
+                        "completed), not a measured throughput")}
+    obj["verdict"] = verdict
+    obj["supervisor"] = {
+        "waited_s": round(time.monotonic() - _T0, 1),
+        "probes_failed": probes_failed,
+        "bench_attempts": attempts,
+        "budget_s": float(os.environ.get("BENCH_WAIT", _DEFAULT_WAIT)),
+        "probe_timeout_s": 90.0,
+    }
+    print(json.dumps(obj), flush=True)
+
+
+def _record_result(result: dict) -> None:
+    """Mirror a completed measurement to BENCH_RESULTS_FILE (set by the
+    supervisor) so the parent can re-emit the best number on its own
+    exit paths without sitting between the child and stdout."""
+    path = os.environ.get("BENCH_RESULTS_FILE")
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(result) + "\n")
+            f.flush()
+    except OSError as e:
+        # never fail the bench over the mirror — but a silent mirror
+        # loss can later make the supervisor under-report, so say so
+        _log(f"results-file mirror write failed: {e}")
+
+
+def _run_child(child_env: dict) -> tuple:
+    """Run the actual bench as a child process. The child INHERITS
+    stdout — its flushed per-config result lines reach the driver's
+    capture directly, even if this supervisor is hard-killed before
+    the child finishes (a pipe tee here would lose exactly the lines
+    the un-failable contract exists to preserve). The child mirrors
+    each result to a temp file, parsed after it exits so the
+    supervisor can re-emit the best result. Returns ``(rc, results)``."""
+    import tempfile
+    fd, path = tempfile.mkstemp(prefix="bench_results_", suffix=".jsonl")
+    os.close(fd)
+    try:
+        rc = subprocess.call(
+            [sys.executable, os.path.abspath(__file__)],
+            env=dict(child_env, BENCH_RESULTS_FILE=path))
+        results = []
+        with open(path) as f:
+            for line in f:
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and obj.get("metric") and \
+                        obj.get("measured", True):
+                    results.append(obj)
+        return rc, results
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def supervise() -> int:
     """Bounded wait-retry: probe every BENCH_PROBE_INTERVAL seconds for
     up to BENCH_WAIT seconds; run the actual bench (as a child process,
@@ -390,11 +503,18 @@ def supervise() -> int:
     guaranteed rc≠0. The child keeps its own in-process watchdog, so a
     tunnel that dies mid-run fails the child in minutes (rc=3) and the
     supervisor goes back to probing with the remaining budget.
+
+    Un-failable under the driver's clock: a status JSON line is flushed
+    after every failed probe and on every exit path, and any result a
+    child flushed before dying is kept — so whether the tunnel is down,
+    half-dead, or flaps mid-ladder, stdout always ends with a parseable
+    object (see module docstring).
     """
-    budget = float(os.environ.get("BENCH_WAIT", "7200"))
+    budget = float(os.environ.get("BENCH_WAIT", _DEFAULT_WAIT))
     interval = float(os.environ.get("BENCH_PROBE_INTERVAL", "120"))
     deadline = time.monotonic() + budget
-    attempts = completed_failures = 0
+    attempts = completed_failures = probes_failed = 0
+    results = []  # every parsed measurement any child flushed
     # The TPU runtime admits ONE process: a background watcher
     # (scripts/tpu_watch_and_run.sh) collecting evidence in the same
     # availability window would hold the chip and fail every probe
@@ -433,15 +553,23 @@ def supervise() -> int:
             if _exec_probe():
                 attempts += 1
                 _log(f"probe OK — starting bench attempt {attempts}")
-                child_env = dict(os.environ, BENCH_WAIT="0")
-                # child inherits stdout: the JSON line flows to the
-                # driver
-                rc = subprocess.call(
-                    [sys.executable, os.path.abspath(__file__)],
-                    env=child_env)
+                rc, child_results = _run_child(
+                    dict(os.environ, BENCH_WAIT="0"))
+                results.extend(child_results)
                 if rc == 0:
+                    # a child that exits 0 has by construction printed
+                    # at least one real result line to the shared
+                    # stdout — if the results-file mirror failed (so
+                    # results is empty), emit NOTHING rather than a
+                    # 0.0 sentinel that would supersede it
+                    if results:
+                        _emit_status("ok", probes_failed=probes_failed,
+                                     attempts=attempts, results=results)
                     return 0
                 _log(f"bench attempt {attempts} failed rc={rc}")
+                _emit_status("bench_attempt_failed",
+                             probes_failed=probes_failed,
+                             attempts=attempts, results=results)
                 # rc=3: child watchdog (tunnel died mid-run); rc=5:
                 # child saw the backend UNAVAILABLE (window closed
                 # right after the probe). Those are transient — keep
@@ -455,14 +583,24 @@ def supervise() -> int:
                         _log("two completed-but-failed attempts — "
                              "giving up (failure looks deterministic, "
                              "not a tunnel flake)")
-                        return rc
+                        _emit_status("bench_failed_deterministically",
+                                     probes_failed=probes_failed,
+                                     attempts=attempts, results=results)
+                        return 0 if results else rc
             else:
+                probes_failed += 1
                 _log("probe: backend down or dispatch hung")
+                _emit_status("waiting_for_tpu",
+                             probes_failed=probes_failed,
+                             attempts=attempts, results=results)
             if time.monotonic() >= deadline:
                 _log(f"BENCH_WAIT budget ({budget:.0f}s) exhausted "
-                     f"with no completed bench — backend never yielded "
-                     f"a usable window")
-                return 4
+                     f"— backend never yielded a usable window")
+                _emit_status("ok_partial" if results
+                             else "tpu_tunnel_down",
+                             probes_failed=probes_failed,
+                             attempts=attempts, results=results)
+                return 0 if results else 4
             time.sleep(max(0.0, interval - (time.monotonic() - t_probe)))
     finally:
         if pause_marker:
@@ -482,7 +620,7 @@ def main():
     # or a TPU-class platform, incl. the axon plugin) with a nonzero
     # wait budget. CPU smoke runs, sweeps, and the supervisor's own
     # children (BENCH_WAIT=0) run directly.
-    if (float(os.environ.get("BENCH_WAIT", "7200")) > 0
+    if (float(os.environ.get("BENCH_WAIT", _DEFAULT_WAIT)) > 0
             and os.environ.get("BENCH_PLATFORM", "tpu") in _tpu_aliases()):
         raise SystemExit(supervise())
 
@@ -495,14 +633,24 @@ def main():
                                        str(top_inner))),
                     os.environ.get("BENCH_LOSS_IMPL", top_impl))]
     else:
-        configs = _LADDER
+        # SMALLEST config first (driver contract, module docstring):
+        # each completed rung flushes its JSON line immediately, so a
+        # kill or tunnel death mid-climb still leaves every number
+        # collected so far on stdout; climbing stops at the first
+        # failed rung after a success (an OOM at batch B repeats at
+        # batch 2B). The default (packed) impl climbs first — fastest
+        # route to a number; the dense rung runs last as the
+        # packed-impl-broke fallback and the on-chip impl comparison.
+        rungs = list(reversed(_LADDER))
+        configs = ([c for c in rungs if c[2] == "packed"]
+                   + [c for c in rungs if c[2] != "packed"])
 
     runner = {"img_clf": run_img, "seg": run_seg}.get(
         os.environ.get("BENCH_TASK", ""), run)
     if runner is run_seg and not pinned:
         # the 262k-query config is memory-bound in BATCH, not in
-        # inner_steps — its ladder degrades the axis that matters
-        configs = [(4, 1, "n/a"), (2, 1, "n/a"), (1, 1, "n/a")]
+        # inner_steps — its ladder climbs the axis that matters
+        configs = [(1, 1, "n/a"), (2, 1, "n/a"), (4, 1, "n/a")]
     elif runner is not run:
         # loss_impl doesn't apply to these tasks — collapse ladder
         # entries that only differ in it (keep first-seen order)
@@ -521,30 +669,58 @@ def main():
         _log(f"backend init failed: {type(e).__name__}: {str(e)[:300]}")
         raise SystemExit(5)
 
-    last_err = None
+    results, last_err = [], None
+    batch_cap = None  # set by the first failure after a success
+    max_ok_batch = 0
     for i, (b, inner, impl) in enumerate(configs):
+        if batch_cap is not None and b > batch_cap:
+            # an OOM at batch B repeats at every larger rung — but
+            # smaller later rungs (the dense comparison at the
+            # already-proven batch) still run
+            _log(f"skipping batch={b} {impl} (cap {batch_cap} after "
+                 f"a failed rung)")
+            continue
         _log(f"config {i + 1}/{len(configs)}: "
              f"batch={b} inner={inner} loss={impl}")
         try:
             result = runner(b, inner, impl)
             _log("done")
-            print(json.dumps(result))
-            return
-        except Exception as e:  # noqa: BLE001 — degrade down the ladder
+            # flush NOW: a kill mid-climb must not lose this rung
+            print(json.dumps(result), flush=True)
+            _record_result(result)
+            results.append(result)
+            max_ok_batch = max(max_ok_batch, b)
+        except Exception as e:  # noqa: BLE001
             # keep only the message: holding the exception would pin
             # the failed run's frames (and its device buffers) alive,
-            # starving the smaller retry configs of the memory the
-            # ladder exists to reclaim
+            # starving the other configs of the memory they need
             last_err = f"{type(e).__name__}: {str(e)[:300]}"
             _log(f"config (batch={b}, inner={inner}, {impl}) "
                  f"failed: {last_err[:220]}")
             if "UNAVAILABLE" in last_err or "Unable to initialize" in last_err:
-                # dead backend, not resource pressure — smaller configs
+                # dead backend, not resource pressure — other configs
                 # would hit the same wall after the same long hang.
-                # rc=5 = transient-tunnel signal to a supervising parent
+                # rc=5 = transient-tunnel signal to a supervising
+                # parent, but only if nothing was measured: with a
+                # number already on stdout, exiting 0 records it
+                # instead of sending the supervisor back to probing
                 _log(f"backend unavailable: {last_err}")
+                if results:
+                    break
                 raise SystemExit(5)
-    raise SystemExit(f"all bench configs failed; last: {last_err}")
+            if results:
+                batch_cap = max_ok_batch
+            # before any success keep trying every rung — a later one
+            # may still produce the round's only number (e.g. the
+            # dense fallback when the packed impl fails for an
+            # impl-specific reason)
+    if not results:
+        raise SystemExit(f"all bench configs failed; last: {last_err}")
+    if len(results) > 1:
+        # re-emit the best rung so a last-line parse records the best
+        # throughput, not merely the largest completed config
+        best = max(results, key=lambda r: r.get("value") or 0)
+        print(json.dumps(best), flush=True)
 
 
 if __name__ == "__main__":

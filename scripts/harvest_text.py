@@ -37,6 +37,12 @@ import sys
 _WORD = re.compile(r"[A-Za-z][a-z]+")
 _WS = re.compile(r"\s+")
 
+# label 1 (pos) = API/reference-style text, 0 (neg) = narrative prose
+_API_WORDS = re.compile(
+    r"\b(parameter|argument|returns?|default|callable|iterable|"
+    r"instance|attribute|keyword|deprecated|subclass|dtype|"
+    r"specify|specified|optional)\b", re.IGNORECASE)
+
 
 def _prose_score(text: str) -> float:
     """Fraction of whitespace tokens that look like English words."""
@@ -167,17 +173,13 @@ def main():
         except OSError:
             pass
     n_dropped = 0
-    api_words = re.compile(
-        r"\b(parameter|argument|returns?|default|callable|iterable|"
-        r"instance|attribute|keyword|deprecated|subclass|dtype|"
-        r"specify|specified|optional)\b", re.IGNORECASE)
     for split, items in splits.items():
         for label in ("neg", "pos"):
             os.makedirs(os.path.join(args.out, "aclImdb", split, label),
                         exist_ok=True)
         # label 1 (pos) = API/reference-style text, 0 (neg) = narrative
         # prose; balance by downsampling the majority class
-        labeled = [(doc, int(bool(api_words.search(doc))))
+        labeled = [(doc, int(bool(_API_WORDS.search(doc))))
                    for doc in items]
         by_label = {0: [d for d, y in labeled if y == 0],
                     1: [d for d, y in labeled if y == 1]}

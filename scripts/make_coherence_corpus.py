@@ -102,12 +102,14 @@ def main():
     ap.add_argument("--src", default=".cache")
     ap.add_argument("--out", default=".cache_coh")
     ap.add_argument("--half-chars", type=int, default=700)
-    ap.add_argument("--val-take", type=int, default=0,
-                    help="move this many source TRAIN docs per style "
-                         "into the test split (doc-level disjoint; "
-                         "only ~7%% of docs fill both halves, so "
-                         "reaching val>=500 coherence examples takes "
-                         "~2500 docs/style — VERDICT r3 weak #3)")
+    ap.add_argument("--extra-test-src", default=None,
+                    help="second harvest root whose aclImdb/test docs "
+                         "AUGMENT the test split (VERDICT r3 weak #3: "
+                         "val>=500). Must contain only text the MLM "
+                         "run never pretrained on — use "
+                         "make_unseen_pool.py, NOT train-split docs "
+                         "(encoder-side val contamination would "
+                         "inflate the transfer arms)")
     args = ap.parse_args()
 
     src_root = os.path.join(args.src, "aclImdb")
@@ -121,16 +123,20 @@ def main():
             for style in ("neg", "pos")}
         for split in ("train", "test")
     }
-    if args.val_take:
-        # deterministic, style-balanced move; shuffled so the moved
-        # docs are a random sample, not the glob-order head
-        rng = random.Random(12345)
+    if args.extra_test_src:
+        n_extra = 0
         for style in ("neg", "pos"):
-            files = list(splits["train"][style])
-            rng.shuffle(files)
-            splits["test"][style] = (splits["test"][style]
-                                     + files[:args.val_take])
-            splits["train"][style] = files[args.val_take:]
+            extra = sorted(glob.glob(os.path.join(
+                args.extra_test_src, "aclImdb", "test", style,
+                "*.txt")))
+            n_extra += len(extra)
+            splits["test"][style] = splits["test"][style] + extra
+        # the unseen pool is usually single-style (balance-dropping
+        # removes the majority class) — that's fine, splices never
+        # cross styles — but an empty pool means a wrong path
+        if not n_extra:
+            sys.exit(f"--extra-test-src has no docs under "
+                     f"{args.extra_test_src}/aclImdb/test")
     for seed, split in enumerate(("train", "test")):
         stats = build_split(splits[split],
                             os.path.join(args.out, "aclImdb", split),

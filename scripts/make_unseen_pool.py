@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Build a pool of harvest-source paragraphs PROVABLY unseen by the
+MLM pretraining run, for contamination-free coherence-val construction.
+
+``harvest_text.py`` balance-downsamples the majority style class, so a
+large slice of the cleaned/deduplicated paragraph pool was never
+written into ``.cache/aclImdb`` at all — never tokenized, never
+pretrained on. This script re-walks the same sources with the same
+cleaning, then keeps ONLY paragraphs whose exact text is absent from
+every file under ``--seen`` (sha1 set over .cache/aclImdb/**): a
+direct, reproducibility-independent disjointness proof. The survivors
+are labeled with the harvest's style regex and written in the
+``aclImdb/test/{pos,neg}`` layout so ``make_coherence_corpus.py
+--extra-test-src`` can fold them into the coherence VAL split.
+
+Why this matters (round-4 review finding): enlarging the coherence val
+split by moving .cache TRAIN docs into it would hand the transfer
+arm's encoder val documents it saw during MLM pretraining — inflating
+the transfer-vs-scratch margin the whole experiment exists to measure.
+This pool grows the val split only with text NO arm has ever seen.
+"""
+
+import argparse
+import glob
+import hashlib
+import importlib.util
+import os
+import shutil
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_spec = importlib.util.spec_from_file_location(
+    "harvest_text", os.path.join(_HERE, "harvest_text.py"))
+harvest = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(harvest)
+
+
+def _seen_hashes(seen_root: str) -> set:
+    seen = set()
+    for path in glob.glob(os.path.join(seen_root, "aclImdb", "*", "*",
+                                       "*.txt")):
+        with open(path, encoding="utf-8") as f:
+            seen.add(hashlib.sha1(f.read().encode()).digest()[:8])
+    return seen
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seen", default=".cache",
+                    help="harvest root whose aclImdb/** contents the "
+                         "MLM pretrained on — nothing matching goes "
+                         "into the pool")
+    ap.add_argument("--out", default=".cache_unseen")
+    ap.add_argument("--max-docs", type=int, default=60_000)
+    args = ap.parse_args()
+
+    seen = _seen_hashes(args.seen)
+    if not seen:
+        sys.exit(f"no harvested docs under {args.seen}/aclImdb — "
+                 "run harvest_text.py first")
+    print(f"seen-paragraph hashes: {len(seen)}", flush=True)
+
+    site_dirs = [p for p in sys.path if p.endswith("site-packages")]
+    doc_roots = site_dirs + ["/usr/share/doc"]
+
+    pool, pool_seen = [], set()
+
+    def add(text):
+        for para in harvest._clean_paragraphs(text):
+            h = hashlib.sha1(para.encode()).digest()[:8]
+            if h in seen or h in pool_seen:
+                continue
+            pool_seen.add(h)
+            pool.append(para)
+
+    for path in harvest._iter_doc_files(doc_roots):
+        try:
+            with open(path, encoding="utf-8", errors="ignore") as f:
+                add(f.read())
+        except OSError:
+            continue
+        if len(pool) >= args.max_docs:
+            break
+    if len(pool) < args.max_docs:
+        for doc in harvest._iter_docstrings(site_dirs):
+            add(doc)
+            if len(pool) >= args.max_docs:
+                break
+
+    out_root = os.path.join(args.out, "aclImdb", "test")
+    shutil.rmtree(os.path.join(args.out, "aclImdb"), ignore_errors=True)
+    counts = {0: 0, 1: 0}
+    for label in ("neg", "pos"):
+        os.makedirs(os.path.join(out_root, label), exist_ok=True)
+    for i, doc in enumerate(pool):
+        y = int(bool(harvest._API_WORDS.search(doc)))
+        counts[y] += 1
+        with open(os.path.join(out_root, ("neg", "pos")[y],
+                               f"u{i}_{5 + y * 5}.txt"), "w",
+                  encoding="utf-8") as f:
+            f.write(doc)
+    print(f"unseen pool: {len(pool)} docs "
+          f"(prose {counts[0]} / api {counts[1]}) -> {out_root}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

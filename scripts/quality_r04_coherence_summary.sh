@@ -16,5 +16,16 @@ for a in "${ARMS[@]}"; do
   ls "logs/$a"/version_*/events.* > /dev/null 2>&1 && have+=("$a")
 done
 (( ${#have[@]} > 0 )) || { echo "no round-4 coherence arms yet"; exit 1; }
-python scripts/quality_summary.py "${have[@]}" > QUALITY_r04_coherence.json
-echo "QUALITY_r04_coherence.json: ${#have[@]} arms"
+# temp + atomic mv: a failed/partial summary run must not clobber the
+# last good QUALITY_r04_coherence.json (this script re-runs after
+# every arm, possibly against a mid-write events file)
+tmp=$(mktemp QUALITY_r04_coherence.json.XXXXXX)
+if python scripts/quality_summary.py "${have[@]}" > "$tmp"; then
+  mv "$tmp" QUALITY_r04_coherence.json
+  echo "QUALITY_r04_coherence.json: ${#have[@]} arms"
+else
+  rc=$?
+  rm -f "$tmp"
+  echo "quality_summary failed (rc=$rc) — keeping previous summary"
+  exit "$rc"
+fi

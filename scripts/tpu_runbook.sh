@@ -41,10 +41,13 @@ run_stage() {
 for s in $STAGES; do
   case $s in
     bench)   # primary metric: MLM tokens/sec/chip + MFU (ladder)
-      run_stage bench env BENCH_WAIT=0 timeout 3000 python bench.py ;;
+      # the unpinned ladder now CLIMBS all rungs (smallest first,
+      # each flushed on completion, so a timeout kill keeps every
+      # completed rung in the stage log) - sized for 4-5 rungs
+      run_stage bench env BENCH_WAIT=0 timeout 3600 python bench.py ;;
     img)     # secondary metric: MNIST imgs/sec/chip
       run_stage img env BENCH_WAIT=0 BENCH_TASK=img_clf \
-        timeout 1800 python bench.py ;;
+        timeout 2400 python bench.py ;;
     kernels) # flash/chunked/einsum on-chip microbench (VERDICT #2),
              # with the flash layout A/B (std vs transposed)
       run_stage kernels env KERNEL_SHAPES="$KSHAPES" \
@@ -59,7 +62,7 @@ for s in $STAGES; do
         --logdir "$OUT/seg_logs" --ckpt-dir "$OUT/seg_ckpt" ;;
     segbench) # pixels/sec JSON line for the 262k-query config
       run_stage segbench env BENCH_WAIT=0 BENCH_TASK=seg "${SEGB_ENV[@]}" \
-        timeout 1800 python bench.py ;;
+        timeout 2400 python bench.py ;;
     sweep)   # batch/inner/loss_impl tuning sweep (longest; last)
       run_stage sweep timeout 6000 python scripts/bench_sweep.py \
         $SWEEP_ARGS ;;

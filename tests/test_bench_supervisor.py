@@ -47,11 +47,12 @@ def test_supervisor_launches_child_on_first_good_probe(bench, monkeypatch):
     calls = []
     monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
 
-    def fake_call(cmd, env=None):
+    def fake_child(env):
         calls.append(env)
-        return 0
+        return 0, [{"metric": "m", "value": 1.0, "unit": "u",
+                    "vs_baseline": None}]
 
-    monkeypatch.setattr(bench.subprocess, "call", fake_call)
+    monkeypatch.setattr(bench, "_run_child", fake_child)
     monkeypatch.setenv("BENCH_WAIT", "60")
     rc = bench.supervise()
     assert rc == 0
@@ -71,8 +72,8 @@ def test_supervisor_retries_after_watchdog_killed_child(bench, monkeypatch):
     monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
     rcs = iter([3, 5, 0])
     calls = []
-    monkeypatch.setattr(bench.subprocess, "call",
-                        lambda cmd, env=None: (calls.append(1), next(rcs))[1])
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda env: (calls.append(1), next(rcs), [])[1:])
     monkeypatch.setenv("BENCH_WAIT", "60")
     monkeypatch.setenv("BENCH_PROBE_INTERVAL", "0.05")
     rc = bench.supervise()
@@ -87,8 +88,8 @@ def test_supervisor_gives_up_on_deterministic_failure(bench, monkeypatch):
     # the driver for hours with no possible payoff.
     monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
     calls = []
-    monkeypatch.setattr(bench.subprocess, "call",
-                        lambda cmd, env=None: calls.append(1) or 1)
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda env: calls.append(1) or (1, []))
     monkeypatch.setenv("BENCH_WAIT", "3600")
     monkeypatch.setenv("BENCH_PROBE_INTERVAL", "0.05")
     rc = bench.supervise()
@@ -97,14 +98,14 @@ def test_supervisor_gives_up_on_deterministic_failure(bench, monkeypatch):
 
 
 def test_supervisor_disables_own_watchdog(bench, monkeypatch):
-    # While blocked in subprocess.call on a healthy long-running child,
-    # nothing kicks the supervisor's in-process watchdog — it must be
-    # inert in supervisor mode or it hard-exits rc=3 mid-child.
+    # While blocked on a healthy long-running child, nothing kicks the
+    # supervisor's in-process watchdog — it must be inert in
+    # supervisor mode or it hard-exits rc=3 mid-child.
     monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
     seen = []
     monkeypatch.setattr(
-        bench.subprocess, "call",
-        lambda cmd, env=None: seen.append(bench._WATCHDOG.timeout) or 0)
+        bench, "_run_child",
+        lambda env: seen.append(bench._WATCHDOG.timeout) or (0, []))
     monkeypatch.setenv("BENCH_WAIT", "60")
     assert bench.supervise() == 0
     assert seen == [0]  # disabled before the child ran
@@ -137,13 +138,193 @@ def test_supervisor_leaves_foreign_marker(bench, monkeypatch, tmp_path):
     monkeypatch.setenv("BENCH_WAIT", "60")
     monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
 
-    def fake_call(cmd, env=None):
+    def fake_child(env):
         marker.write_text("999999")  # another instance took over
-        return 0
+        return 0, []
 
-    monkeypatch.setattr(bench.subprocess, "call", fake_call)
+    monkeypatch.setattr(bench, "_run_child", fake_child)
     assert bench.supervise() == 0
     assert marker.read_text() == "999999"  # foreign marker untouched
+
+
+# --- round-4 driver contract (VERDICT r3 weak #1): stdout must end
+# --- with a parseable JSON object no matter when the driver's ~1800 s
+# --- hard kill lands ------------------------------------------------
+
+
+def _json_lines(captured_out):
+    lines = []
+    for ln in captured_out.splitlines():
+        try:
+            lines.append(__import__("json").loads(ln))
+        except ValueError:
+            pass
+    return lines
+
+
+def test_supervisor_emits_parseable_status_on_every_failed_probe(
+        bench, monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: False)
+    monkeypatch.setenv("BENCH_WAIT", "0.3")
+    monkeypatch.setenv("BENCH_PROBE_INTERVAL", "0.1")
+    assert bench.supervise() == 4
+    lines = _json_lines(capsys.readouterr().out)
+    # one status object per failed probe, every one schema-complete —
+    # a tail-only or last-line parse can land anywhere and still parse
+    assert len(lines) >= 2
+    for obj in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= obj.keys()
+        assert obj["measured"] is False
+        assert obj["value"] == 0.0
+    assert lines[-1]["verdict"] == "tpu_tunnel_down"
+    assert lines[-1]["supervisor"]["probes_failed"] >= 2
+
+
+def test_supervisor_default_wait_fits_driver_budget(bench):
+    # the driver hard-kills at ~1800 s (BENCH_r03.json: rc=124, tail
+    # stops at +1770 s) — the default wait must exhaust well inside
+    # that, leaving room for the final status line. Worst case adds
+    # one full probe (90 s) + the probe interval past the deadline.
+    assert float(bench._DEFAULT_WAIT) + 90 + 120 <= 1700
+    # the default must be read from the constant everywhere
+    src = open(os.path.join(_ROOT, "bench.py")).read()
+    assert 'os.environ.get("BENCH_WAIT", "' not in src
+
+
+def test_supervisor_keeps_child_results_across_transient_failure(
+        bench, monkeypatch, capsys):
+    # a child that flushed a measurement and then died on a tunnel
+    # flake (rc=3) must not lose the number: when the budget then
+    # exhausts, the supervisor re-emits the best result and exits 0
+    result = {"metric": "m", "value": 5.0, "unit": "u",
+              "vs_baseline": None}
+    monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_run_child", lambda env: (3, [result]))
+    monkeypatch.setenv("BENCH_WAIT", "0.1")
+    monkeypatch.setenv("BENCH_PROBE_INTERVAL", "0.05")
+    assert bench.supervise() == 0
+    last = _json_lines(capsys.readouterr().out)[-1]
+    assert last["value"] == 5.0
+    assert last["verdict"] == "ok_partial"
+
+
+def test_supervisor_reemits_best_result_last(bench, monkeypatch, capsys):
+    # two rungs completed before the child died: the FINAL stdout line
+    # must carry the best throughput, not the last or the sentinel
+    results = [{"metric": "m", "value": 10.0, "unit": "u",
+                "vs_baseline": None},
+               {"metric": "m", "value": 30.0, "unit": "u",
+                "vs_baseline": None}]
+    monkeypatch.setattr(bench, "_exec_probe", lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_run_child", lambda env: (0, results))
+    monkeypatch.setenv("BENCH_WAIT", "60")
+    assert bench.supervise() == 0
+    last = _json_lines(capsys.readouterr().out)[-1]
+    assert last["value"] == 30.0
+    assert last["verdict"] == "ok"
+
+
+def test_run_child_inherits_stdout_and_parses_results_file(
+        bench, monkeypatch):
+    # the child must INHERIT stdout (no pipe between its flushed
+    # result lines and the driver's capture — a supervisor hard-kill
+    # must not lose them) and mirror results to BENCH_RESULTS_FILE,
+    # which _run_child parses, excluding sentinels and noise
+    import json as _json
+
+    seen = {}
+
+    def fake_call(cmd, env=None):
+        # stdout/stderr NOT redirected: the child writes straight to
+        # the driver's capture
+        seen["env"] = env
+        with open(env["BENCH_RESULTS_FILE"], "w") as f:
+            f.write(_json.dumps({"metric": "m", "value": 1.0,
+                                 "unit": "u", "vs_baseline": None})
+                    + "\n")
+            f.write(_json.dumps({"metric": "m", "value": 0.0,
+                                 "unit": "u", "vs_baseline": None,
+                                 "measured": False}) + "\n")
+            f.write("partial garbage line\n")
+            f.write(_json.dumps({"metric": "m", "value": 2.0,
+                                 "unit": "u", "vs_baseline": None})
+                    + "\n")
+        return 7
+
+    monkeypatch.setattr(bench.subprocess, "call", fake_call)
+    rc, results = bench._run_child({"BENCH_WAIT": "0"})
+    assert rc == 7
+    assert [r["value"] for r in results] == [1.0, 2.0]
+    assert seen["env"]["BENCH_WAIT"] == "0"
+    assert not os.path.exists(seen["env"]["BENCH_RESULTS_FILE"])
+
+
+def test_ladder_mirrors_results_to_results_file(bench, monkeypatch,
+                                                tmp_path):
+    # the direct-mode ladder must append each completed rung to
+    # BENCH_RESULTS_FILE so the supervisor can recover numbers from a
+    # child that later died
+    path = tmp_path / "results.jsonl"
+    monkeypatch.setenv("BENCH_RESULTS_FILE", str(path))
+    bench._record_result({"metric": "m", "value": 3.0, "unit": "u",
+                          "vs_baseline": None})
+    import json as _json
+    assert _json.loads(path.read_text())["value"] == 3.0
+
+
+def test_ladder_climbs_smallest_first_and_flushes(bench, monkeypatch,
+                                                  capsys):
+    # unpinned direct mode: packed rungs smallest-first, each result
+    # printed the moment it lands; an OOM caps the batch (skipping
+    # larger rungs) but the dense comparison rung at the proven batch
+    # still runs; best rung re-emitted last
+    import json as _json
+
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    monkeypatch.setenv("BENCH_WAIT", "0")
+    monkeypatch.setattr(bench, "probe_backend", lambda: None)
+    calls = []
+
+    def fake_run(b, inner, impl):
+        calls.append((b, inner, impl))
+        if b >= 256:
+            raise RuntimeError("RESOURCE_EXHAUSTED: oom")
+        return {"metric": "m", "value": float(b), "unit": "u",
+                "vs_baseline": None, "detail": {"loss_impl": impl}}
+
+    monkeypatch.setattr(bench, "run", fake_run)
+    bench.main()
+    # 512 skipped (over the 128 cap); dense at 64 still collected
+    assert calls == [(64, 1, "packed"), (128, 4, "packed"),
+                     (256, 8, "packed"), (64, 1, "dense")]
+    values = [_json.loads(ln)["value"]
+              for ln in capsys.readouterr().out.splitlines()]
+    assert values == [64.0, 128.0, 64.0, 128.0]  # best re-emitted last
+
+
+def test_ladder_falls_back_to_dense_when_packed_never_succeeds(
+        bench, monkeypatch, capsys):
+    import json as _json
+
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    monkeypatch.setenv("BENCH_WAIT", "0")
+    monkeypatch.setattr(bench, "probe_backend", lambda: None)
+    calls = []
+
+    def fake_run(b, inner, impl):
+        calls.append((b, inner, impl))
+        if impl == "packed":
+            raise RuntimeError("Mosaic lowering failed")
+        return {"metric": "m", "value": 9.0, "unit": "u",
+                "vs_baseline": None, "detail": {"loss_impl": impl}}
+
+    monkeypatch.setattr(bench, "run", fake_run)
+    bench.main()
+    assert calls[-1] == (64, 1, "dense")  # fallback reached
+    assert len(calls) == 5  # all packed rungs tried first
+    out = [_json.loads(ln)
+           for ln in capsys.readouterr().out.splitlines()]
+    assert out[-1]["value"] == 9.0
 
 
 def test_cpu_smoke_skips_supervisor(bench, monkeypatch):
