@@ -86,6 +86,37 @@ def _split_heads(x, num_heads: int):
     return x.reshape(b, l, num_heads, e // num_heads)
 
 
+@jax.custom_vjp
+def _qk_dot(qh, kh):
+    """QK^T with fp32 accumulation forward and a bf16 cotangent
+    backward.
+
+    Forward is bitwise-identical to the plain einsum (bf16 operands,
+    ``preferred_element_type=f32`` — the MXU accumulates in fp32
+    natively). Backward casts the incoming fp32 softmax cotangent to
+    bf16 before the two large grad contractions, the same trade every
+    production flash-attention backward makes: without it XLA upcasts
+    both dots to fp32, which the TPU executes at a fraction of the
+    bf16 MXU rate (graph audit: scripts/hlo_audit.py)."""
+    return jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                      preferred_element_type=jnp.float32)
+
+
+def _qk_dot_fwd(qh, kh):
+    return _qk_dot(qh, kh), (qh, kh)
+
+
+def _qk_dot_bwd(res, g):
+    qh, kh = res
+    gb = g.astype(jnp.bfloat16)
+    dq = jnp.einsum("bhqk,bkhd->bqhd", gb, kh)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", gb, qh)
+    return dq.astype(qh.dtype), dk.astype(kh.dtype)
+
+
+_qk_dot.defvjp(_qk_dot_fwd, _qk_dot_bwd)
+
+
 _SPMD_IMPLS = ("seqpar", "ring", "ulysses")
 
 
@@ -200,8 +231,16 @@ def mha_apply(params, q, k, v, *, num_heads: int,
         return linear_apply(params["out"], out, policy=policy)
 
     scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, policy.norm_dtype))
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
-                        preferred_element_type=policy.norm_dtype)
+    if policy.compute_dtype == jnp.bfloat16:
+        # fp32-accumulated forward, bf16-cotangent backward (see
+        # _qk_dot): without this the two QK-backward dots inherit the
+        # fp32 softmax cotangent and run at the MXU's fp32 rate —
+        # ~9% of headline-config step FLOPs at ~8x the cost
+        # (logs/hlo_audit_r04_b512_c64.json)
+        logits = _qk_dot(qh, kh)
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                            preferred_element_type=policy.norm_dtype)
     logits = logits.astype(policy.norm_dtype) * scale
 
     if attn_mask is not None:

@@ -76,6 +76,51 @@ def test_mha_init_matches_torch_fan_math():
         assert float(jnp.abs(w).max()) > 0.8 * sep_bound, name
 
 
+def test_mha_bf16_backward_has_no_fp32_dots():
+    """Under the bf16 policy EVERY attention matmul — including the
+    QK backward pair fed by the fp32 softmax cotangent — must run with
+    bf16 operands (the TPU executes fp32 dots at a fraction of the
+    bf16 MXU rate; graph audit scripts/hlo_audit.py found the backward
+    pair at ~9% of headline-step FLOPs before the _qk_dot fix)."""
+    import re
+
+    from perceiver_tpu.ops.policy import Policy
+
+    p = mha_init(jax.random.key(0), q_dim=32, num_heads=4)
+    q = jax.random.normal(jax.random.key(1), (2, 8, 32))
+    kv = jax.random.normal(jax.random.key(2), (2, 16, 32))
+    bf16 = Policy.bf16()
+
+    def loss(params, q, kv):
+        return mha_apply(params, q, kv, kv, num_heads=4,
+                         policy=bf16).astype(jnp.float32).sum()
+
+    text = jax.jit(jax.grad(loss)).lower(p, q, kv).as_text()
+    bad = []
+    for ln in text.splitlines():
+        if "stablehlo.dot_general" not in ln:
+            continue
+        ops = re.search(r": \(tensor<([^>]+)>, tensor<([^>]+)>\)", ln)
+        assert ops is not None, ln
+        if "f32" in ops.group(1) or "f32" in ops.group(2):
+            bad.append(ln.strip()[:160])
+    assert not bad, bad[:3]
+
+    # and the bf16 grads stay close to the fp32-policy reference
+    fp32 = Policy.fp32()
+
+    def loss32(params, q, kv):
+        return mha_apply(params, q, kv, kv, num_heads=4,
+                         policy=fp32).sum()
+
+    g16 = jax.grad(loss)(p, q, kv)
+    g32 = jax.grad(loss32)(p, q, kv)
+    for name in ("q", "k", "v"):
+        a, b = g16[name]["w"], g32[name]["w"]
+        denom = float(jnp.abs(b).max()) + 1e-9
+        assert float(jnp.abs(a - b).max()) / denom < 5e-2, name
+
+
 def test_mha_output_shape_asymmetric_kv():
     p = mha_init(jax.random.key(0), q_dim=32, num_heads=4, k_dim=131,
                  v_dim=131)
