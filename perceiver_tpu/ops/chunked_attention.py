@@ -36,6 +36,60 @@ def pad_mask_to_bias(key_padding_mask, dtype=jnp.float32):
     return jnp.where(key_padding_mask, NEG_INF, 0.0).astype(dtype)
 
 
+# --- bf16-cotangent dots ----------------------------------------------
+# The online-softmax recurrence keeps its statistics (m, l, acc) in
+# fp32, so under autodiff every cotangent reaching the two block dots
+# is fp32 — XLA then upcasts the dots' bf16 operands and runs the
+# ENTIRE backward at the MXU's fp32 rate. These custom-vjp wrappers
+# keep the fp32-accumulated forward bitwise identical and cast the
+# cotangent to bf16 before the grad contractions — the same trade the
+# production flash-attention backward makes (and that ops/attention.py
+# _qk_dot makes for the materialized path). Applied only when the
+# operands are bf16; the fp32 policy path is untouched.
+
+
+@jax.custom_vjp
+def _qk_block_dot(q, k_blk):
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                      preferred_element_type=jnp.float32)
+
+
+def _qk_block_dot_fwd(q, k_blk):
+    return _qk_block_dot(q, k_blk), (q, k_blk)
+
+
+def _qk_block_dot_bwd(res, g):
+    q, k_blk = res
+    gb = g.astype(jnp.bfloat16)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", gb, k_blk)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", gb, q)
+    return dq.astype(q.dtype), dk.astype(k_blk.dtype)
+
+
+_qk_block_dot.defvjp(_qk_block_dot_fwd, _qk_block_dot_bwd)
+
+
+@jax.custom_vjp
+def _pv_block_dot(p, v_blk):
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v_blk,
+                      preferred_element_type=jnp.float32)
+
+
+def _pv_block_dot_fwd(p, v_blk):
+    return _pv_block_dot(p, v_blk), (p, v_blk)
+
+
+def _pv_block_dot_bwd(res, g):
+    p, v_blk = res
+    gb = g.astype(jnp.bfloat16)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gb, v_blk)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gb)
+    return dp.astype(p.dtype), dv.astype(v_blk.dtype)
+
+
+_pv_block_dot.defvjp(_pv_block_dot_fwd, _pv_block_dot_bwd)
+
+
 def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc,
                dropout_rate: float = 0.0, dropout_key=None):
     """One online-softmax block fold — THE shared recurrence.
@@ -57,8 +111,12 @@ def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc,
     out = (1/l)·Σ_k mask_k/(1−rate)·exp_k·v_k. So ``acc`` folds the
     dropped exp block and ``l`` the undropped one.
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
-                   preferred_element_type=jnp.float32) * scale
+    bf16_ops = (q.dtype == jnp.bfloat16 and k_blk.dtype == jnp.bfloat16)
+    if bf16_ops:
+        s = _qk_block_dot(q, k_blk) * scale
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
     if bias_blk is not None:
         s = s + bias_blk[:, None, None, :].astype(jnp.float32)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -71,9 +129,12 @@ def fold_block(q, k_blk, v_blk, bias_blk, scale, m, l, acc,
         p_acc = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     else:
         p_acc = p
-    acc_new = acc * alpha + jnp.einsum(
-        "bhqk,bhkd->bhqd", p_acc.astype(v_blk.dtype), v_blk,
-        preferred_element_type=jnp.float32)
+    if v_blk.dtype == jnp.bfloat16:
+        pv = _pv_block_dot(p_acc.astype(v_blk.dtype), v_blk)
+    else:
+        pv = jnp.einsum("bhqk,bhkd->bhqd", p_acc.astype(v_blk.dtype),
+                        v_blk, preferred_element_type=jnp.float32)
+    acc_new = acc * alpha + pv
     return m_new, l_new, acc_new
 
 

@@ -91,20 +91,26 @@ def test_mha_bf16_backward_has_no_fp32_dots():
     kv = jax.random.normal(jax.random.key(2), (2, 16, 32))
     bf16 = Policy.bf16()
 
-    def loss(params, q, kv):
-        return mha_apply(params, q, kv, kv, num_heads=4,
-                         policy=bf16).astype(jnp.float32).sum()
+    def check_no_f32_dots(impl):
+        def loss(params, q, kv):
+            return mha_apply(params, q, kv, kv, num_heads=4, impl=impl,
+                             policy=bf16).astype(jnp.float32).sum()
 
-    text = jax.jit(jax.grad(loss)).lower(p, q, kv).as_text()
-    bad = []
-    for ln in text.splitlines():
-        if "stablehlo.dot_general" not in ln:
-            continue
-        ops = re.search(r": \(tensor<([^>]+)>, tensor<([^>]+)>\)", ln)
-        assert ops is not None, ln
-        if "f32" in ops.group(1) or "f32" in ops.group(2):
-            bad.append(ln.strip()[:160])
-    assert not bad, bad[:3]
+        text = jax.jit(jax.grad(loss)).lower(p, q, kv).as_text()
+        bad = []
+        for ln in text.splitlines():
+            if "stablehlo.dot_general" not in ln:
+                continue
+            ops = re.search(r": \(tensor<([^>]+)>, tensor<([^>]+)>\)",
+                            ln)
+            assert ops is not None, ln
+            if "f32" in ops.group(1) or "f32" in ops.group(2):
+                bad.append(ln.strip()[:160])
+        assert not bad, (impl, bad[:3])
+        return loss
+
+    loss = check_no_f32_dots("einsum")
+    check_no_f32_dots("chunked")
 
     # and the bf16 grads stay close to the fp32-policy reference
     fp32 = Policy.fp32()
