@@ -1,0 +1,67 @@
+#!/bin/bash
+# Consolidated round-4 quality evidence → QUALITY_r04.json: the
+# completed 14k-step MLM schedule (curve + final validate), pointers
+# to the 3-seed coherence table and the BoW certificate, and the
+# graph-audit perf findings. Rerunnable; run once more right before
+# round end to capture the latest arms.
+set -u
+cd "$(dirname "$0")/.."
+
+FINAL_VAL=""
+if [[ -f logs/mlm_final_validate_r04.log ]]; then
+  FINAL_VAL=$(grep -oE "val_loss=[0-9.]+" logs/mlm_final_validate_r04.log \
+              | tail -1 | cut -d= -f2)
+fi
+
+python - "$FINAL_VAL" <<'EOF' > QUALITY_r04.json.tmp
+import json, subprocess, sys
+
+final_val = sys.argv[1] or None
+
+def summary(*exps):
+    out = subprocess.run(
+        [sys.executable, "scripts/quality_summary.py", *exps],
+        capture_output=True, text=True)
+    lines = out.stdout.splitlines()
+    start = next((i for i, l in enumerate(lines) if l.startswith("{")),
+                 None)
+    if out.returncode != 0 or start is None:
+        sys.stderr.write(out.stderr)
+        sys.exit(f"quality_summary failed (rc={out.returncode}) for "
+                 f"{exps}")
+    return json.loads("\n".join(lines[start:]))
+
+doc = {
+    "round": 4,
+    "mlm_pretraining": summary("mlm_quality", "mlm_cpu_quality"),
+    "mlm_final_validate": {
+        "step": 14000,
+        "val_loss": float(final_val) if final_val else None,
+        "platform": "cpu",
+        "note": ("completed 14k-step OneCycle schedule (VERDICT r3 "
+                 "next #6); reproduce with scripts/mlm.py validate "
+                 "--ckpt_path=<furthest mlm_quality ckpt>"),
+    },
+    "coherence_transfer": ("see QUALITY_r04_coherence.json (3-seed "
+                           "full-label arms on .cache_coh4: val 806, "
+                           "contamination-free unseen-pool val docs)"),
+    "bow_control": "see QUALITY_r04_bow_control.json (at-chance)",
+    "perf_graph_audit": ("see logs/hlo_audit_r04_b512_c64.json — "
+                         "bf16_flop_fraction 1.0 after the bf16-"
+                         "cotangent fix; K-ceiling 0.657 (C=64) / "
+                         "0.919 (C=128)"),
+    "egress_retry": ("aclImdb + published-ckpt hosts retried this "
+                     "session: DNS failure (zero egress still)"),
+}
+json.dump(doc, sys.stdout, indent=1)
+EOF
+rc=$?
+if (( rc == 0 )); then
+  echo "" >> QUALITY_r04.json.tmp
+  mv QUALITY_r04.json.tmp QUALITY_r04.json
+  python -c "import json; d=json.load(open('QUALITY_r04.json')); \
+print('QUALITY_r04.json ok:', list(d))"
+else
+  rm -f QUALITY_r04.json.tmp
+  exit "$rc"
+fi
