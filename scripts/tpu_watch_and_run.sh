@@ -156,17 +156,22 @@ while true; do
   # interrupted build can never masquerade as a complete corpus; fresh
   # labels deliberately use new names — stale clf_phase*.done files
   # from the pre-coherence label scheme must not skip these.
-  step coh_corpus   600  300 python scripts/make_coherence_corpus.py \
-      --half-chars 400 || continue
-  step coh_phase1  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh \
+  # round-4 corpus protocol: val 806 >= 500 via the hash-disjoint
+  # unseen pool (never moves MLM-pretraining docs into val), tuned
+  # phase-2 lr 3e-4; reuses the already-built corpus when present
+  step coh_corpus   900  300 bash -c '[ -d .cache_coh4/aclImdb ] || { \
+      python scripts/make_unseen_pool.py && \
+      python scripts/make_coherence_corpus.py --out .cache_coh4 \
+        --half-chars 420 --extra-test-src .cache_unseen; }' || continue
+  step coh_phase1  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh4 \
       --model.mlm_ckpt="$(furthest_ckpt $(mlm_quality_ckpt_globs))" \
       --model.freeze_encoder=true --trainer.max_steps=3000 \
       --trainer.steps_per_execution=8 --experiment=coh_tpu_phase1 || continue
-  step coh_phase2  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh \
+  step coh_phase2  3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh4 \
       --model.clf_ckpt="$(furthest_ckpt logs/coh_tpu_phase1/version_*/checkpoints*)" \
-      --optimizer.init_args.lr=0.0001 --trainer.max_steps=1500 \
+      --optimizer.init_args.lr=0.0003 --trainer.max_steps=1500 \
       --trainer.steps_per_execution=8 --experiment=coh_tpu_phase2 || continue
-  step coh_scratch 3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh \
+  step coh_scratch 3600  900 python scripts/seq_clf.py fit --data.data_dir=.cache_coh4 \
       --trainer.max_steps=4500 --trainer.steps_per_execution=8 \
       --experiment=coh_tpu_scratch || continue
   say "ALL EVIDENCE COLLECTED"
