@@ -9,8 +9,9 @@ cd "$(dirname "$0")/.."
 
 FINAL_VAL=""
 if [[ -f logs/mlm_final_validate_r04.log ]]; then
-  FINAL_VAL=$(grep -oE "val_loss=[0-9.]+" logs/mlm_final_validate_r04.log \
-              | tail -1 | cut -d= -f2)
+  FINAL_VAL=$(grep -oE "val_loss[:=] ?[0-9.]+" \
+              logs/mlm_final_validate_r04.log \
+              | tail -1 | grep -oE "[0-9.]+$")
 fi
 
 python - "$FINAL_VAL" <<'EOF' > QUALITY_r04.json.tmp
