@@ -338,12 +338,16 @@ def flash_attention(q, k, v, *, bias: Optional[jax.Array] = None,
     """Fused attention. q: (B, H, Lq, D); k, v: (B, H, Lk, D);
     bias: optional (B, Lk) additive key bias (NEG_INF at padding).
     Returns (B, H, Lq, D) in q's dtype."""
-    from perceiver_tpu.utils.platform import is_tpu_platform
+    from perceiver_tpu.utils.platform import (
+        assume_tpu_target,
+        is_tpu_platform,
+    )
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         # see pallas_ce: plugin TPU backends ("axon") must not fall
         # into interpreter mode on the real chip
-        interpret = not is_tpu_platform(jax.default_backend())
+        interpret = not (is_tpu_platform(jax.default_backend())
+                         or assume_tpu_target())
     return _flash(q, k, v, bias, float(scale), int(block_q), int(block_k),
                   bool(interpret))

@@ -273,13 +273,17 @@ def pallas_linear_cross_entropy(linear_params, hidden, labels, weight, *,
     zero gradient (they are masks/targets, not trained).
     """
     from perceiver_tpu.ops.policy import DEFAULT_POLICY
-    from perceiver_tpu.utils.platform import is_tpu_platform
+    from perceiver_tpu.utils.platform import (
+        assume_tpu_target,
+        is_tpu_platform,
+    )
     policy = policy or DEFAULT_POLICY
     if interpret is None:
         # plugin TPU backends report their own platform name ("axon"),
         # not "tpu" — a name check against "tpu" alone would silently
         # run the kernel in interpreter mode on the real chip
-        interpret = not is_tpu_platform(jax.default_backend())
+        interpret = not (is_tpu_platform(jax.default_backend())
+                         or assume_tpu_target())
 
     n = hidden.shape[0]
     h = policy.cast_compute(hidden)

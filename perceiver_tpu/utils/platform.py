@@ -26,3 +26,17 @@ def tpu_platform_names() -> tuple:
 
 def is_tpu_platform(name: str) -> bool:
     return name in tpu_platform_names()
+
+
+def assume_tpu_target() -> bool:
+    """True when AOT-compiling FOR a TPU from a non-TPU host backend.
+
+    Offline ahead-of-time compilation against a TPU
+    ``TopologyDescription`` (``jax.experimental.topologies`` — no live
+    device needed, the local libtpu compiles) runs with the CPU
+    backend active, so ``is_tpu_platform(jax.default_backend())`` is
+    False even though the kernels WILL execute on a TPU. Exporting
+    ``PERCEIVER_TPU_ASSUME_TPU=1`` tells the Pallas call sites to pick
+    the real Mosaic kernels instead of interpreter mode (see
+    ``scripts/mosaic_aot_check.py``)."""
+    return bool(os.environ.get("PERCEIVER_TPU_ASSUME_TPU"))

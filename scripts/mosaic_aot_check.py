@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Offline Mosaic compile-proof for every Pallas kernel (VERDICT r3
+missing #2 — without waiting for the tunnel).
+
+The container ships a LOCAL libtpu, so ``jax.experimental.topologies``
+can AOT-compile executables for a real TPU target (``v5e:2x2`` →
+device_kind "TPU v5 lite", matching the tunnel chip) with no live
+device: XLA runs its full TPU pipeline and Pallas kernels go through
+MOSAIC, not the interpreter. This checks the compile-time constraints
+that three rounds of interpreter-only testing could not — block/tile
+legality, the transposed layout's (D, L) blocking, the bias sublane
+trick, the fused-CE grids — and records real-TPU ``memory_analysis``
+numbers for each executable.
+
+What it cannot check: runtime behavior/perf. Execution proof still
+needs a live chip (the watcher collects it), but a kernel that
+compiles cleanly for the exact device_kind removes the biggest risk:
+Mosaic rejecting the kernel outright.
+
+Checks (each its own entry in the JSON report):
+  * flash_attention, standard (L, D) layout  — D=64, fwd + grad
+  * flash_attention, transposed (D, L) layout — D=16, fwd + grad
+  * flash_attention with additive key bias (the padding path)
+  * pallas fused vocab-CE — fwd + grad (Mosaic bwd kernels)
+  * full MLM train step, attention_impl=flash + loss_impl=pallas
+    (everything-Mosaic) at bench batch 64
+  * full MLM train step at the headline bench rung (batch 512) —
+    with memory_analysis: does the top rung fit v5e HBM?
+
+Usage: python scripts/mosaic_aot_check.py [--json OUT]
+Env:   MOSAIC_TOPOLOGY (default v5e:2x2)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ["PERCEIVER_TPU_ASSUME_TPU"] = "1"  # Mosaic, not interpreter
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # never touches the tunnel
+
+import jax.numpy as jnp
+from jax.experimental import topologies
+
+
+def _sharding():
+    topo = topologies.get_topology_desc(
+        os.environ.get("MOSAIC_TOPOLOGY", "v5e:2x2"), platform="tpu")
+    return (jax.sharding.SingleDeviceSharding(topo.devices[0]),
+            topo.devices[0].device_kind)
+
+
+def _sds(shape, dtype, sh):
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+
+
+def _mem(compiled):
+    try:
+        m = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:120]}
+    out = {}
+    for k in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes"):
+        v = getattr(m, k, None)
+        if v is not None:
+            out[k.replace("_in_bytes", "_mb")] = round(v / 2**20, 1)
+    if "argument_size_mb" in out and "temp_size_mb" in out:
+        out["approx_peak_mb"] = round(out["argument_size_mb"]
+                                      + out["temp_size_mb"], 1)
+    return out
+
+
+def _check(name, fn, *args):
+    t0 = time.monotonic()
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+        txt = compiled.as_text()
+        entry = {
+            "ok": True,
+            "mosaic_custom_call": "custom-call" in txt,
+            "compile_s": round(time.monotonic() - t0, 1),
+            "memory": _mem(compiled),
+        }
+    except Exception as e:  # noqa: BLE001
+        entry = {"ok": False, "error": f"{type(e).__name__}: "
+                 f"{str(e)[:400]}",
+                 "compile_s": round(time.monotonic() - t0, 1)}
+    print(f"[{name}] {entry}", file=sys.stderr, flush=True)
+    return name, entry
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default="logs/MOSAIC_AOT_r04.json")
+    args = ap.parse_args()
+
+    sh, device_kind = _sharding()
+    report = {"device_kind": device_kind,
+              "topology": os.environ.get("MOSAIC_TOPOLOGY", "v5e:2x2"),
+              "note": ("AOT compile via local libtpu against a TPU "
+                       "TopologyDescription — no live device; Mosaic "
+                       "compiles the Pallas kernels (interpret=False "
+                       "via PERCEIVER_TPU_ASSUME_TPU). Execution "
+                       "proof still requires a chip."),
+              "checks": {}}
+
+    from perceiver_tpu.ops.pallas_attention import flash_attention
+
+    def flash_grad(q, k, v):
+        return jax.grad(lambda q, k, v: flash_attention(q, k, v)
+                        .astype(jnp.float32).sum())(q, k, v)
+
+    # standard layout: D=64 (e.g. 8-head/512-channel shapes)
+    q64 = _sds((2, 8, 512, 64), jnp.bfloat16, sh)
+    # transposed layout: D=16 — EVERY 64-channel/4-head BASELINE
+    # config; the layout with the untested sublane tricks
+    q16 = _sds((2, 4, 512, 16), jnp.bfloat16, sh)
+    bias = _sds((2, 512), jnp.float32, sh)
+
+    checks = [
+        ("flash_std_fwd",
+         lambda q, k, v: flash_attention(q, k, v), q64, q64, q64),
+        ("flash_std_grad", flash_grad, q64, q64, q64),
+        ("flash_transposed_fwd",
+         lambda q, k, v: flash_attention(q, k, v), q16, q16, q16),
+        ("flash_transposed_grad", flash_grad, q16, q16, q16),
+        ("flash_bias_fwd",
+         lambda q, k, v, b: flash_attention(q, k, v, bias=b),
+         q16, q16, q16, bias),
+    ]
+
+    from perceiver_tpu.ops.pallas_ce import pallas_linear_cross_entropy
+
+    rows, c, vocab = 1024, 64, 10003
+    lp = {"w": _sds((c, vocab), jnp.float32, sh),
+          "b": _sds((vocab,), jnp.float32, sh)}
+    h = _sds((rows, c), jnp.bfloat16, sh)
+    y = _sds((rows,), jnp.int32, sh)
+    wt = _sds((rows,), jnp.float32, sh)
+
+    checks.append(("pallas_ce_fwd",
+                   lambda lp, h, y, wt: pallas_linear_cross_entropy(
+                       lp, h, y, wt), lp, h, y, wt))
+    checks.append(("pallas_ce_grad",
+                   lambda lp, h, y, wt: jax.grad(
+                       lambda lp, h: pallas_linear_cross_entropy(
+                           lp, h, y, wt).astype(jnp.float32),
+                       argnums=(0, 1))(lp, h), lp, h, y, wt))
+
+    for item in checks:
+        name, entry = _check(item[0], item[1], *item[2:])
+        report["checks"][name] = entry
+
+    # --- full train steps: everything-Mosaic MLM ----------------------
+    import optax
+
+    from perceiver_tpu.ops.policy import Policy
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    def train_step_check(name, batch_size, **task_kw):
+        task = MaskedLanguageModelTask(
+            vocab_size=10003, max_seq_len=512, **task_kw)
+        model = task.build()
+        policy = Policy.bf16()
+        params = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+        tx = optax.adamw(1e-3)
+        opt_state = jax.eval_shape(tx.init, params)
+        put = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: _sds(x.shape, x.dtype, sh), t)
+        batch = {"input_ids": _sds((batch_size, 512), jnp.int32, sh),
+                 "pad_mask": _sds((batch_size, 512), jnp.bool_, sh)}
+        rng = jax.ShapeDtypeStruct((), jax.random.key(0).dtype,
+                                   sharding=sh)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, batch, rng):
+            def loss_fn(p):
+                loss, _ = task.loss_and_metrics(
+                    model, p, batch, rng=rng, deterministic=False,
+                    policy=policy)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        nm, entry = _check(name, step, put(params), put(opt_state),
+                           batch, rng)
+        report["checks"][nm] = entry
+
+    train_step_check("mlm_step_flash_pallasce_b64", 64,
+                     attention_impl="flash", loss_impl="pallas")
+    train_step_check("mlm_step_default_b512", 512, loss_impl="packed")
+
+    ok = sum(1 for c in report["checks"].values() if c.get("ok"))
+    report["summary"] = f"{ok}/{len(report['checks'])} compiled"
+    out = json.dumps(report, indent=1)
+    print(out)
+    with open(args.json, "w") as f:
+        f.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()
