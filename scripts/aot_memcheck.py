@@ -179,6 +179,22 @@ def check_mlm_bench(batch: int):
     return _compile_train_step(task, batch_arrs, f"mlm_b{batch}")
 
 
+def check_seg(batch: int = 2, side: int = 512):
+    """The 512×512 / 262,144-output-query LArTPC segmentation config
+    (``run.py:72-112``) — the decoder query-chunking memory stress."""
+    import jax.numpy as jnp
+
+    from perceiver_tpu.tasks import SegmentationTask
+
+    task = SegmentationTask(image_shape=(side, side, 1),
+                            query_chunk_size=min(16384, side * side))
+    batch_arrs = {
+        "image": jnp.zeros((batch, side, side, 1), jnp.float32),
+        "label": jnp.zeros((batch, side, side), jnp.int32),
+    }
+    return _compile_train_step(task, batch_arrs, f"seg{side}_b{batch}")
+
+
 def main():
     import jax
 
@@ -187,11 +203,14 @@ def main():
         jax.config.update("jax_platforms", want)
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
 
-    out = {"device": str(jax.devices()[0])}
+    out = {"device": str(jax.devices()[0]),
+           "topology": os.environ.get("MEMCHECK_TOPOLOGY")}
     if which in ("224", "all"):
         out["classifier_224"] = check_224()
     if which in ("lm", "all"):
         out["perceiver_lm_v5p16_shard"] = check_lm()
+    if which in ("seg", "all"):
+        out["seg_512_262k_queries"] = check_seg()
     if which in ("bench", "all"):
         for b in (512, 1024):
             out[f"mlm_bench_b{b}"] = check_mlm_bench(b)
