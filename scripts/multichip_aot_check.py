@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Offline multi-chip compile-proof: the sharded flagship train step
+compiles for an 8-device TPU v5e topology through the REAL TPU
+compiler (GSPMD partitioning + ICI collectives), no devices needed.
+
+Complements `__graft_entry__.dryrun_multichip`, which compiles AND
+executes the same step on 8 *virtual CPU* devices: the CPU run proves
+numerics, this proves the TPU-compiler path — partitioning rules,
+collective lowering, and Mosaic custom calls inside the shard_map
+sequence-parallel kernels — against device_kind "TPU v5 lite".
+
+Mesh: dp2 × sp2 × tp2 (the dryrun's flagship layout) over a v5e:2x4
+topology. One compile per sequence-parallel impl (seqpar, ring,
+ulysses). Reports per-impl compile status, collective ops found in
+the executable, and memory_analysis.
+
+Usage: python scripts/multichip_aot_check.py [--json OUT]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ["PERCEIVER_TPU_ASSUME_TPU"] = "1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import topologies
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_COLLECTIVES = ("all-reduce", "all-gather", "collective-permute",
+                "reduce-scatter", "all-to-all")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default="logs/MULTICHIP_AOT_r04.json")
+    args = ap.parse_args()
+
+    topo = topologies.get_topology_desc(
+        os.environ.get("MOSAIC_TOPOLOGY", "v5e:2x4"), platform="tpu")
+    devs = np.array(topo.devices).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("data", "seq", "model"))
+    print(f"[multichip-aot] mesh {dict(mesh.shape)} on "
+          f"{topo.devices[0].device_kind}", file=sys.stderr, flush=True)
+
+    import optax
+
+    from perceiver_tpu.ops.policy import Policy
+    from perceiver_tpu.parallel import param_sharding, seq_sharding
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    policy = Policy.fp32()  # mirrors dryrun_multichip
+    report = {"device_kind": topo.devices[0].device_kind,
+              "mesh": dict(mesh.shape),
+              "note": ("AOT compile of the dp2*sp2*tp2 flagship train "
+                       "step against a v5e:2x4 TopologyDescription — "
+                       "real TPU compiler, no live devices; execution "
+                       "coverage comes from dryrun_multichip on the "
+                       "virtual CPU mesh"),
+              "impls": {}}
+
+    def sds(x, sharding):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    for impl in ("seqpar", "ring", "ulysses"):
+        t0 = time.monotonic()
+        try:
+            task = MaskedLanguageModelTask(vocab_size=10003,
+                                           max_seq_len=512,
+                                           attention_impl=impl)
+            model = task.build(mesh=mesh)
+            params = jax.eval_shape(
+                lambda m=model: m.init(jax.random.key(0)))
+            pshard = param_sharding(params, mesh)
+            params = jax.tree.map(sds, params, pshard)
+            tx = optax.adamw(1e-3)
+            bshard = seq_sharding(mesh)
+            ids = sds(jnp.zeros((4, 512), jnp.int32), bshard)
+            pad = sds(jnp.zeros((4, 512), jnp.bool_), bshard)
+            rng = jax.ShapeDtypeStruct(
+                (), jax.random.key(0).dtype,
+                sharding=NamedSharding(mesh, P()))
+
+            # opt state is INITIALIZED inside the step: GSPMD then
+            # propagates each mu/nu shard from its parameter, which
+            # sidesteps hand-assembling an opt-state sharding tree
+            # for abstract inputs (eval_shape drops shardings)
+            @jax.jit
+            def train_step(params, ids, pad, rng):
+                opt_state = tx.init(params)
+
+                def loss_fn(p):
+                    logits, labels = model.apply(
+                        p, ids, pad, rng=rng, deterministic=False,
+                        policy=policy)
+                    logp = jax.nn.log_softmax(
+                        logits.astype(jnp.float32))
+                    mask = labels != -100
+                    safe = jnp.clip(labels, 0)
+                    nll = -jnp.take_along_axis(
+                        logp, safe[..., None], -1)[..., 0]
+                    return (nll * mask).sum() / jnp.maximum(
+                        mask.sum(), 1)
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                updates, opt_state = tx.update(grads, opt_state,
+                                               params)
+                return (optax.apply_updates(params, updates),
+                        opt_state, loss)
+
+            with mesh:
+                compiled = train_step.lower(params, ids, pad,
+                                            rng).compile()
+            txt = compiled.as_text()
+            colls = {c: len(re.findall(re.escape(c) + r"[.( ]", txt))
+                     for c in _COLLECTIVES}
+            m = compiled.memory_analysis()
+            entry = {
+                "ok": True,
+                "compile_s": round(time.monotonic() - t0, 1),
+                "collectives": {k: v for k, v in colls.items() if v},
+                "mosaic_custom_call": "custom-call" in txt,
+                "per_device_temp_mb": round(
+                    getattr(m, "temp_size_in_bytes", 0) / 2**20, 1),
+            }
+        except Exception as e:  # noqa: BLE001
+            entry = {"ok": False,
+                     "error": f"{type(e).__name__}: {str(e)[:400]}",
+                     "compile_s": round(time.monotonic() - t0, 1)}
+        print(f"[{impl}] {entry}", file=sys.stderr, flush=True)
+        report["impls"][impl] = entry
+
+    ok = sum(1 for v in report["impls"].values() if v.get("ok"))
+    report["summary"] = f"{ok}/{len(report['impls'])} impls compiled"
+    out = json.dumps(report, indent=1)
+    print(out)
+    with open(args.json, "w") as f:
+        f.write(out + "\n")
+
+
+if __name__ == "__main__":
+    main()
