@@ -1,0 +1,82 @@
+"""Mosaic compile-legality regression net (no TPU needed).
+
+The container's local libtpu can AOT-compile executables for a real
+TPU target via ``jax.experimental.topologies`` — which means Mosaic
+itself checks the Pallas kernels' block/tile legality at test time,
+something interpreter-mode tests cannot do (three rounds of VERDICT
+flagged exactly this gap). A kernel edit that breaks Mosaic lowering
+for the tunnel's device_kind ("TPU v5 lite") fails here, not in the
+next scarce availability window.
+
+Execution coverage stays with the interpreter-mode tests; these only
+compile.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(scope="module")
+def v5e_sharding(monkeypatch_module=None):
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
+    except Exception as e:  # noqa: BLE001 — no local libtpu build
+        pytest.skip(f"TPU topology AOT unavailable: {e}")
+    return jax.sharding.SingleDeviceSharding(topo.devices[0])
+
+
+@pytest.fixture(autouse=True)
+def _assume_tpu(monkeypatch):
+    # the kernels must pick Mosaic, not interpreter, when compiling
+    # from the CPU host backend for a TPU target
+    monkeypatch.setenv("PERCEIVER_TPU_ASSUME_TPU", "1")
+
+
+def _compile(fn, *args):
+    compiled = jax.jit(fn).lower(*args).compile()
+    return compiled.as_text()
+
+
+def test_flash_std_layout_mosaic_compiles(v5e_sharding):
+    from perceiver_tpu.ops.pallas_attention import flash_attention
+
+    q = jax.ShapeDtypeStruct((2, 8, 512, 64), jnp.bfloat16,
+                             sharding=v5e_sharding)
+    txt = _compile(lambda q, k, v: flash_attention(q, k, v), q, q, q)
+    assert "custom-call" in txt  # Mosaic kernel, not interpreter HLO
+
+
+def test_flash_transposed_layout_mosaic_compiles(v5e_sharding):
+    # D=16: the (D, L) transposed layout with the bias sublane trick —
+    # the layout every 64-channel BASELINE config uses
+    from perceiver_tpu.ops.pallas_attention import flash_attention
+
+    q = jax.ShapeDtypeStruct((2, 4, 512, 16), jnp.bfloat16,
+                             sharding=v5e_sharding)
+    b = jax.ShapeDtypeStruct((2, 512), jnp.float32,
+                             sharding=v5e_sharding)
+    txt = _compile(lambda q, k, v, b: flash_attention(q, k, v, bias=b),
+                   q, q, q, b)
+    assert "custom-call" in txt
+
+
+def test_pallas_ce_mosaic_compiles(v5e_sharding):
+    from perceiver_tpu.ops.pallas_ce import pallas_linear_cross_entropy
+
+    sh = v5e_sharding
+    lp = {"w": jax.ShapeDtypeStruct((64, 10003), jnp.float32,
+                                    sharding=sh),
+          "b": jax.ShapeDtypeStruct((10003,), jnp.float32, sharding=sh)}
+    h = jax.ShapeDtypeStruct((1024, 64), jnp.bfloat16, sharding=sh)
+    y = jax.ShapeDtypeStruct((1024,), jnp.int32, sharding=sh)
+    wt = jax.ShapeDtypeStruct((1024,), jnp.float32, sharding=sh)
+    txt = _compile(
+        lambda lp, h, y, wt: pallas_linear_cross_entropy(lp, h, y, wt),
+        lp, h, y, wt)
+    assert "custom-call" in txt
