@@ -50,7 +50,11 @@ def test_build_split_balance_and_provenance(mcc, tmp_path):
         for i in range(8):
             (d / f"{i}_5.txt").write_text(_doc(f"{style}doc{i}"))
     out = tmp_path / "out" / "train"
-    stats = mcc.build_split(str(src), str(out), half_chars=200, seed=0)
+    import glob as _glob
+    style_files = {style: sorted(_glob.glob(str(src / style / "*.txt")))
+                   for style in ("neg", "pos")}
+    stats = mcc.build_split(style_files, str(out), half_chars=200,
+                            seed=0)
     assert stats["pos"] == stats["neg"] > 0
 
     import glob

