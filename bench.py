@@ -59,6 +59,16 @@ from functools import partial
 
 import numpy as np
 
+# Persistent XLA compilation cache, shared across processes: in a
+# short tunnel window every probe/child/watcher step pays cold
+# compiles (the batch-512 rung took ~650 s on the v5e compiler) — with
+# the cache, only the FIRST process in a window compiles each config.
+# Must be set before jax initializes; harmless for CPU smoke runs.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".jax_cache"))  # same dir the watcher exports
+
 # (batch_size, inner_steps, loss_impl), most → least aggressive.
 # MFU analysis (C=64 contracts the MXU's 128-deep K dim at 50%, so the
 # ~40% target needs ~80% relative efficiency): the FLOP majority is
