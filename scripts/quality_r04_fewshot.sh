@@ -1,0 +1,73 @@
+#!/bin/bash
+# Round-4 few-shot arms: 512 labeled examples from the r04 corpus,
+# full 806-example val — the label-efficiency regime where pretrained
+# representations should matter most (mirrors the round-3 fs_* arms,
+# now on the contamination-free corpus with the finished 14k encoder).
+# Seeds 0 and 1; scratch gets both round-3-best lrs per seed.
+set -u
+cd "$(dirname "$0")/.."
+. scripts/lib_ckpt.sh
+
+if [[ ! -d .cache_coh4_small/aclImdb ]]; then
+  python - <<'EOF'
+import glob, os, random, shutil
+random.seed(0)
+src, dst = ".cache_coh4", ".cache_coh4_small"
+shutil.rmtree(dst, ignore_errors=True)
+for label in ("neg", "pos"):
+    files = sorted(glob.glob(f"{src}/aclImdb/train/{label}/*.txt"))
+    random.shuffle(files)
+    d = f"{dst}/aclImdb/train/{label}"
+    os.makedirs(d)
+    for f in files[:256]:
+        shutil.copy(f, d)
+for label in ("neg", "pos"):
+    d = f"{dst}/aclImdb/test/{label}"
+    os.makedirs(d)
+    for f in glob.glob(f"{src}/aclImdb/test/{label}/*.txt"):
+        shutil.copy(f, d)
+for tok in glob.glob(f"{src}/imdb-tokenizer-*.json"):
+    shutil.copy(tok, dst)
+print("built .cache_coh4_small:",
+      len(glob.glob(f"{dst}/aclImdb/train/*/*.txt")), "train /",
+      len(glob.glob(f"{dst}/aclImdb/test/*/*.txt")), "test")
+EOF
+fi
+
+MLM_CKPT=$(furthest_ckpt $(mlm_quality_ckpt_globs))
+[[ -d "$MLM_CKPT" ]] || { echo "no MLM checkpoint"; exit 1; }
+
+COMMON=(--data.data_dir=.cache_coh4_small --data.batch_size=32
+        --trainer.log_every_n_steps=50 --trainer.accelerator=cpu)
+
+run() {
+  local name=$1; shift
+  if [[ -e "logs/$name.done" ]]; then
+    echo "== $name already complete — skipping"
+    return 0
+  fi
+  echo "== $name: $(date -u +%FT%TZ)"
+  python scripts/seq_clf.py fit "${COMMON[@]}" --experiment="$name" "$@" \
+    > "logs/$name.log" 2>&1
+  local rc=$?
+  echo "== $name done rc=$rc $(date -u +%FT%TZ)"
+  if (( rc != 0 )); then
+    echo "== $name FAILED — aborting (see logs/$name.log)"
+    exit "$rc"
+  fi
+  touch "logs/$name.done"
+}
+
+for s in 0 1; do
+  run "fs4_phase1_s$s" --trainer.seed=$s --model.freeze_encoder=true \
+      --model.mlm_ckpt="$MLM_CKPT" --trainer.max_steps=300
+  PH1=$(furthest_ckpt "logs/fs4_phase1_s$s"/version_*/checkpoints*)
+  [[ -d "$PH1" ]] || { echo "no phase-1 ckpt seed $s"; exit 1; }
+  run "fs4_phase2_s$s" --trainer.seed=$s --model.clf_ckpt="$PH1" \
+      --optimizer.init_args.lr=0.0003 --trainer.max_steps=300
+  run "fs4_scratch_lr1e-4_s$s" --trainer.seed=$s \
+      --optimizer.init_args.lr=0.0001 --trainer.max_steps=600
+  run "fs4_scratch_lr3e-4_s$s" --trainer.seed=$s \
+      --optimizer.init_args.lr=0.0003 --trainer.max_steps=600
+done
+echo "== few-shot arms complete: $(date -u +%FT%TZ)"
