@@ -9,7 +9,9 @@ cd "$(dirname "$0")/.."
 ARMS=()
 for s in 0 1 2; do
   ARMS+=("coh4_phase1_s$s" "coh4_phase2_s$s"
-         "coh4_scratch_lr1e-4_s$s" "coh4_scratch_lr3e-4_s$s")
+         "coh4_scratch_lr1e-4_s$s" "coh4_scratch_lr3e-4_s$s"
+         "fs4_phase1_s$s" "fs4_phase2_s$s"
+         "fs4_scratch_lr1e-4_s$s" "fs4_scratch_lr3e-4_s$s")
 done
 have=()
 for a in "${ARMS[@]}"; do

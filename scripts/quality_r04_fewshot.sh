@@ -58,7 +58,7 @@ run() {
   touch "logs/$name.done"
 }
 
-for s in 0 1; do
+for s in 0 1 2; do
   run "fs4_phase1_s$s" --trainer.seed=$s --model.freeze_encoder=true \
       --model.mlm_ckpt="$MLM_CKPT" --trainer.max_steps=300
   PH1=$(furthest_ckpt "logs/fs4_phase1_s$s"/version_*/checkpoints*)
