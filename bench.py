@@ -59,6 +59,8 @@ from functools import partial
 
 import numpy as np
 
+from perceiver_tpu.utils.timing import fence
+
 # Persistent XLA compilation cache, shared across processes: in a
 # short tunnel window every probe/child/watcher step pays cold
 # compiles (the batch-512 rung took ~650 s on the v5e compiler) — with
@@ -230,7 +232,11 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     t_warm = time.perf_counter()
     params, opt_state, loss = train_steps(params, opt_state, stacked_batch,
                                           key)
-    jax.block_until_ready(loss)
+    # host-fetch fence, NOT block_until_ready: the axon tunnel acks
+    # block_until_ready before the chip finishes (utils/timing.py), so
+    # without a real fence the warmup's work would bleed into and
+    # corrupt the timed window below
+    fence(loss)
     _log(f"warm ({time.perf_counter() - t_warm:.2f}s); timing ...")
 
     profile_dir = os.environ.get("BENCH_PROFILE")
@@ -238,23 +244,39 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
         jax.profiler.start_trace(profile_dir)
 
     try:
-        n_dispatch = max(20 // inner_steps, 3)
+        n_dispatch = int(os.environ.get("BENCH_DISPATCHES", "0")) \
+            or max(64 // inner_steps, 8)
         n_steps = n_dispatch * inner_steps
+        # all dispatch keys up front: an eager jax.random.fold_in
+        # inside the timed loop costs host tracing + a tunnel dispatch
+        # (~200 ms each in the b256 profile trace) that has nothing to
+        # do with step throughput. Iterating the split performs the
+        # eager slices HERE, before the clock starts.
+        dispatch_keys = list(jax.random.split(key, n_dispatch))
+        fence(jax.random.key_data(dispatch_keys[-1]))
         dt = 0.0
         for i in range(n_dispatch):
-            key = jax.random.fold_in(key, i)
+            key = dispatch_keys[i]
             t_i = time.perf_counter()
             params, opt_state, loss = train_steps(params, opt_state,
                                                   stacked_batch, key)
-            # per-dispatch sync: negligible overhead at these dispatch
-            # sizes, and a hung tunnel shows up as a stalled dispatch i
-            # in the log instead of one silent multi-minute wait. dt
-            # sums only the dispatch+sync segments, so the flushed
-            # stderr log below (potentially slow over a tunnel) stays
-            # out of the measured window.
+            # liveness only — a hung tunnel shows up as a stalled
+            # dispatch i in the log instead of one silent multi-minute
+            # wait. NOT a fence: the axon tunnel acks this before the
+            # chip finishes, and dispatches stay pipelined exactly as
+            # the real trainer pipelines them.
             jax.block_until_ready(loss)
             dt += time.perf_counter() - t_i
-            _log(f"dispatch {i + 1}/{n_dispatch} done (+{dt:.2f}s)")
+            # the log write stays OUT of the summed segments (slow
+            # stderr must not inflate the measurement)
+            _log(f"dispatch {i + 1}/{n_dispatch} enqueued (+{dt:.2f}s)")
+        # the one TRUE fence: host-fetch of the final loss scalar — it
+        # data-depends on every step, so the summed wall clock includes
+        # all n_steps of real chip work plus one tunnel round trip
+        t_f = time.perf_counter()
+        final_loss = fence(loss)
+        dt += time.perf_counter() - t_f
+        _log(f"fenced: {n_steps} steps in {dt:.2f}s")
     finally:
         # always close the trace — a mid-loop OOM must not leave the
         # profiler open (the next ladder config's start_trace would
@@ -289,7 +311,7 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
             "mfu": round(util, 4) if util is not None else None,
             "step_tflops": (round(step_flops / 1e12, 3)
                             if step_flops else None),
-            "loss": float(loss),
+            "loss": final_loss,
             "device": str(jax.devices()[0]),
             # truthful evidence labeling (VERDICT r2 #7): what the
             # numbers were actually measured on, machine-readable

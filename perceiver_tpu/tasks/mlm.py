@@ -196,18 +196,26 @@ class MaskedLanguageModelTask(TaskConfig):
             # overflow = contributing rows silently dropped by the
             # static capacity: it biases the loss, so it must be
             # observable — as a TB scalar (train_ce_overflow) and as a
-            # loud in-stream warning the moment it first goes nonzero
+            # loud in-stream warning the moment it first goes nonzero.
+            # The warning lowers to a host callback, which the axon
+            # tunnel plugin cannot dispatch — there the TB scalar is
+            # the whole signal (utils/platform.py).
             import jax
 
-            jax.lax.cond(
-                overflow > 0,
-                lambda ov: jax.debug.print(
-                    "WARNING: packed-CE capacity overflow — {n} "
-                    "contributing positions dropped from the loss; "
-                    "raise packed_capacity or use loss_impl='fused'",
-                    n=ov),
-                lambda ov: None,
-                overflow)
+            from perceiver_tpu.utils.platform import (
+                host_callbacks_supported,
+            )
+
+            if host_callbacks_supported():
+                jax.lax.cond(
+                    overflow > 0,
+                    lambda ov: jax.debug.print(
+                        "WARNING: packed-CE capacity overflow — {n} "
+                        "contributing positions dropped from the loss; "
+                        "raise packed_capacity or use loss_impl='fused'",
+                        n=ov),
+                    lambda ov: None,
+                    overflow)
             metrics["ce_overflow"] = overflow
         adapter_params = params["decoder"]["output_adapter"]["linear"]
         if self.loss_impl == "pallas":

@@ -43,6 +43,7 @@ from perceiver_tpu.utils.flops import (
     step_flops_and_fn,
 )
 from perceiver_tpu.utils.tb import SummaryWriter
+from perceiver_tpu.utils.timing import fence
 
 _UNLIMITED_EPOCHS = 1000  # Lightning's default cap for max_epochs=-1
 
@@ -625,8 +626,12 @@ class Trainer:
                 steps_since += len(group)
                 if first_step or first_single:
                     # this dispatch paid a jit compilation; keep it
-                    # out of the throughput/MFU measurement window
-                    jax.block_until_ready(metrics)
+                    # out of the throughput/MFU measurement window.
+                    # fence(), not block_until_ready: the axon tunnel
+                    # acks block_until_ready before the chip finishes
+                    # (utils/timing.py), which would leak compile +
+                    # first-step work into the next window
+                    fence(metrics)
                     t0, samples_since, steps_since = time.time(), 0, 0
 
                 crossed_log = (self.global_step // cfg.log_every_n_steps
@@ -634,8 +639,10 @@ class Trainer:
                 if crossed_log or cfg.fast_dev_run:
                     # async dispatch: sync on the device before taking
                     # dt, else the window measures host dispatch time
-                    # and over-reports throughput/MFU
-                    jax.block_until_ready(metrics)
+                    # and over-reports throughput/MFU; must be a host
+                    # fetch (utils/timing.py), not block_until_ready,
+                    # which the axon tunnel acks early
+                    fence(metrics)
                     self._check_nan(metrics)
                     dt = time.time() - t0
                     throughput = samples_since / max(dt, 1e-9)

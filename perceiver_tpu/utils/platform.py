@@ -28,6 +28,36 @@ def is_tpu_platform(name: str) -> bool:
     return name in tpu_platform_names()
 
 
+def host_callbacks_supported() -> bool:
+    """Whether the active backend can run host send/recv callbacks.
+
+    ``jax.debug.print`` / ``io_callback`` lower to host send/recv ops;
+    this container's axon tunnel plugin rejects them at dispatch time
+    (``UNIMPLEMENTED: axon_pjrt does not support host send/recv
+    callbacks``), which would take down any train step that embeds
+    one. Call sites that use callbacks for *observability only* (the
+    packed-CE overflow warning) must degrade to their silent path —
+    the TB scalar carries the signal either way. Overridable for other
+    restricted plugins via ``PERCEIVER_TPU_NO_HOST_CALLBACKS=1``.
+    """
+    if os.environ.get("PERCEIVER_TPU_NO_HOST_CALLBACKS"):
+        return False
+    if assume_tpu_target():
+        # AOT cross-compile for a TPU target from a CPU host: the live
+        # backend is NOT what the executable will run on. Compile the
+        # conservative (callback-free) program so the AOT check
+        # validates the same HLO the axon runtime would trace.
+        return False
+    import jax
+
+    try:
+        # The tunnel plugin reports platform "tpu" like a real chip;
+        # its PJRT platform_version string is where "axon" shows up.
+        return "axon" not in jax.devices()[0].client.platform_version.lower()
+    except Exception:
+        return True
+
+
 def assume_tpu_target() -> bool:
     """True when AOT-compiling FOR a TPU from a non-TPU host backend.
 

@@ -1,0 +1,39 @@
+"""True completion fences for benchmark timing.
+
+``block_until_ready`` is the documented way to synchronize before
+reading a wall clock — and on this container's axon tunnel it returns
+long before the remote chip has finished executing (measured: a 13.7
+TFLOP matmul chain "completes" in 0.2 ms ⇒ an impossible 84 PFLOP/s,
+while a host fetch of one result element takes the honest 0.14-0.2 s).
+Every timed region must therefore end with a HOST FETCH of a value
+that data-depends on the computation: a device→host transfer cannot
+complete before the producing computation does, on any backend.
+
+The fence costs one tunnel round trip (~30-70 ms here), so timed
+regions should cover enough work to amortize it, and the fence scalar
+should be tiny (fetching a full activation tensor would measure
+transfer bandwidth, not compute).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fence(x) -> float:
+    """Block until ``x`` is REALLY computed; returns one element as float.
+
+    ``x`` may be a jax array of any shape or a pytree (first leaf is
+    used). A scalar is fetched directly; for larger arrays a one-element
+    slice is dispatched on device first so only bytes for a single
+    element cross the wire.
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(x)
+    if not leaves:
+        return 0.0
+    leaf = leaves[0]
+    if getattr(leaf, "ndim", 0):
+        leaf = leaf.ravel()[0]
+    return float(np.asarray(jax.device_get(leaf)))

@@ -49,6 +49,7 @@ def main():
         cross_attention_init,
         cross_attention_apply,
     )
+    from perceiver_tpu.utils.timing import fence
 
     print(f"device: {jax.devices()[0]}", flush=True)
     for name in names:
@@ -77,18 +78,22 @@ def main():
             grad = jax.jit(jax.grad(fwd))
             fj = jax.jit(fwd)
             try:
-                fj(params, q, kv).block_until_ready()  # compile
+                # fence(), not block_until_ready: the axon tunnel
+                # acks block_until_ready before the chip finishes
+                # (utils/timing.py), which would time dispatch latency
+                # instead of the kernels
+                fence(fj(params, q, kv))  # compile + first run
                 t0 = time.perf_counter()
                 for _ in range(reps):
                     out = fj(params, q, kv)
-                out.block_until_ready()
+                fence(out)
                 f_ms = (time.perf_counter() - t0) / reps * 1e3
 
-                jax.block_until_ready(grad(params, q, kv))  # compile
+                fence(grad(params, q, kv))  # compile + first run
                 t0 = time.perf_counter()
                 for _ in range(reps):
                     g = grad(params, q, kv)
-                jax.block_until_ready(g)
+                fence(g)
                 fb_ms = (time.perf_counter() - t0) / reps * 1e3
                 print(f"{name:9s} (B{b} q{nq} kv{nkv} c{c}) "
                       f"{impl:7s} fwd {f_ms:8.2f} ms   "

@@ -24,6 +24,8 @@ import sys
 import threading
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 
 def _out(obj):
     print(json.dumps(obj), flush=True)
@@ -65,12 +67,16 @@ def main():
     n = 4096
     x = jnp.ones((n, n), jnp.bfloat16)
     f = jax.jit(lambda a: a @ a)
-    f(x).block_until_ready()  # compile + first run
+    # fence(), not block_until_ready: the axon tunnel acks
+    # block_until_ready before the chip finishes (utils/timing.py)
+    from perceiver_tpu.utils.timing import fence
+
+    fence(f(x))  # compile + first run
     t = time.perf_counter()
     reps = 10
     for _ in range(reps):
         y = f(x)
-    y.block_until_ready()
+    fence(y)
     dt = time.perf_counter() - t
     _out({"stage": "matmul", "n": n,
           "tflops": round(2 * n**3 * reps / dt / 1e12, 2),
@@ -92,7 +98,7 @@ def main():
     v = jax.random.normal(kv, (b, h, lk, d), jnp.float32)
     t = time.perf_counter()
     o = flash_attention(q, k, v)  # interpret=None → real kernel on TPU
-    o.block_until_ready()
+    fence(o)
     compile_s = time.perf_counter() - t
     ref = einsum_attention_reference(q, k, v)
     err = float(jnp.max(jnp.abs(o - ref)))
@@ -100,7 +106,7 @@ def main():
     reps = 20
     for _ in range(reps):
         o = flash_attention(q, k, v)
-    o.block_until_ready()
+    fence(o)
     us = (time.perf_counter() - t) / reps * 1e6
     from perceiver_tpu.utils.platform import is_tpu_platform
 
@@ -129,7 +135,7 @@ def main():
         jnp.float32)
     t = time.perf_counter()
     loss = pallas_linear_cross_entropy(lp, hid, lab, wgt, policy=pol)
-    loss.block_until_ready()
+    fence(loss)
     compile_s = time.perf_counter() - t
     ref = fused_linear_cross_entropy(lp, hid, lab, wgt, chunk_size=256,
                                      policy=pol)
