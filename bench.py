@@ -321,14 +321,32 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     }
 
 
+def _env_knobs() -> dict:
+    """Perf knobs (trace-driven, r05): the b256 trace showed the step
+    HBM-bound at ~38 GB accessed/step — the levers that cut traffic
+    are the streaming CE (loss_impl=pallas, MLM only),
+    non-materializing attention (BENCH_ATTN_IMPL=chunked|flash),
+    decoder ditto (BENCH_DEC_IMPL), and remat (BENCH_REMAT=1:
+    recompute instead of storing scan residuals — FLOPs are nearly
+    free at this MFU). Shared TaskConfig fields, so every BENCH_TASK
+    honors them; the values are echoed into the result detail dict so
+    rows from different knob combinations stay distinguishable."""
+    return dict(
+        attention_impl=os.environ.get("BENCH_ATTN_IMPL") or None,
+        decoder_attention_impl=os.environ.get("BENCH_DEC_IMPL") or None,
+        kv_chunk_size=int(os.environ.get("BENCH_KV_CHUNK", "1024")),
+        remat=os.environ.get("BENCH_REMAT", "0") == "1")
+
+
 def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
     import jax.numpy as jnp
 
     from perceiver_tpu.tasks import MaskedLanguageModelTask
 
     seq_len, vocab = 512, 10003
-    task = MaskedLanguageModelTask(vocab_size=vocab, max_seq_len=seq_len,
-                                   loss_impl=loss_impl)
+    task = MaskedLanguageModelTask(
+        vocab_size=vocab, max_seq_len=seq_len, loss_impl=loss_impl,
+        **_env_knobs())
     rng = np.random.default_rng(0)
     stacked = {
         "input_ids": jnp.asarray(rng.integers(
@@ -339,7 +357,8 @@ def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
         task, stacked, batch_size=batch_size, inner_steps=inner_steps,
         units_per_step=batch_size * seq_len,
         metric="imdb_mlm_tokens_per_sec_per_chip", unit="tokens/s",
-        detail={"seq_len": seq_len, "loss_impl": loss_impl})
+        detail={"seq_len": seq_len, "loss_impl": loss_impl,
+                **_env_knobs()})
 
 
 def run_img(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
@@ -355,7 +374,7 @@ def run_img(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
         image_shape=(28, 28, 1), num_classes=10, num_frequency_bands=32,
         num_latents=32, num_latent_channels=128, num_encoder_layers=3,
         num_encoder_self_attention_layers_per_block=3,
-        num_decoder_cross_attention_heads=1)
+        num_decoder_cross_attention_heads=1, **_env_knobs())
     rng = np.random.default_rng(0)
     stacked = {
         "image": jnp.asarray(rng.normal(
@@ -367,7 +386,7 @@ def run_img(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
         task, stacked, batch_size=batch_size, inner_steps=inner_steps,
         units_per_step=batch_size,
         metric="mnist_imgs_per_sec_per_chip", unit="imgs/s",
-        detail={"image_shape": [28, 28, 1]})
+        detail={"image_shape": [28, 28, 1], **_env_knobs()})
 
 
 def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
@@ -383,7 +402,8 @@ def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
     del loss_impl  # weighted CE over 3 classes; no fused variants
     side = int(os.environ.get("BENCH_SEG_SIZE", "512"))
     task = SegmentationTask(image_shape=(side, side, 1),
-                            query_chunk_size=min(16384, side * side))
+                            query_chunk_size=min(16384, side * side),
+                            **_env_knobs())
     rng = np.random.default_rng(0)
     stacked = {
         "image": jnp.asarray(
@@ -398,7 +418,7 @@ def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
         units_per_step=batch_size * side * side,
         metric="lartpc_seg_pixels_per_sec_per_chip", unit="pixels/s",
         detail={"image_shape": [side, side, 1],
-                "num_output_queries": side * side})
+                "num_output_queries": side * side, **_env_knobs()})
 
 
 # Probe run in a SUBPROCESS: a half-dead tunnel blocks block_until_ready

@@ -40,6 +40,12 @@ class TaskConfig:
     # "ulysses"
     attention_impl: Optional[str] = None
     kv_chunk_size: int = 1024
+    # Attention kernel for the decoder's output-query ← latent
+    # cross-attention (PerceiverDecoder.attention_impl). None keeps the
+    # einsum path; "chunked"/"flash" stream the latent kv without
+    # materializing the (B, M, N) weight tensor. The SPMD impls shard
+    # the encoder token axis and do not apply to output queries.
+    decoder_attention_impl: Optional[str] = None
     # import a trained reference (PyTorch / PyTorch-Lightning)
     # checkpoint as this task's full model — the migration path for
     # reference users (reference README.md:72-74; utils/torch_import)
@@ -65,6 +71,19 @@ class TaskConfig:
                 f"support attention-weight dropout "
                 f"(dropout={self.dropout}); use attention_impl="
                 "'einsum' or 'chunked', or set --model.dropout=0")
+        if self.decoder_attention_impl not in (None, "einsum", "chunked",
+                                               "flash"):
+            raise ValueError(
+                f"decoder_attention_impl="
+                f"{self.decoder_attention_impl!r} — the decoder "
+                "cross-attention supports None, 'einsum', 'chunked', or "
+                "'flash' (the SPMD impls shard the encoder token axis "
+                "and do not apply to output queries)")
+        if self.dropout > 0.0 and self.decoder_attention_impl == "flash":
+            raise ValueError(
+                "decoder_attention_impl='flash' does not support "
+                f"attention-weight dropout (dropout={self.dropout}); "
+                "use 'einsum' or 'chunked', or set --model.dropout=0")
 
     @property
     def latent_shape(self) -> Tuple[int, int]:

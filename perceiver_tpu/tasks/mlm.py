@@ -97,7 +97,9 @@ class MaskedLanguageModelTask(TaskConfig):
             output_adapter=output_adapter,
             latent_shape=self.latent_shape,
             num_cross_attention_heads=self.num_decoder_cross_attention_heads,
-            dropout=self.dropout)
+            dropout=self.dropout,
+            attention_impl=self.decoder_attention_impl,
+            kv_chunk_size=self.kv_chunk_size)
         masking = TextMasking(
             vocab_size=self.vocab_size, unk_token_id=UNK_TOKEN_ID,
             mask_token_id=MASK_TOKEN_ID,

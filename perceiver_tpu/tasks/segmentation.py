@@ -84,6 +84,8 @@ class SegmentationTask(TaskConfig):
             latent_shape=self.latent_shape,
             num_cross_attention_heads=self.num_decoder_cross_attention_heads,
             dropout=self.dropout,
+            attention_impl=self.decoder_attention_impl,
+            kv_chunk_size=self.kv_chunk_size,
             query_chunk_size=chunk)
         return PerceiverIO(encoder, decoder)
 

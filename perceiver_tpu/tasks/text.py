@@ -52,7 +52,9 @@ class TextClassifierTask(TaskConfig):
             output_adapter=output_adapter,
             latent_shape=self.latent_shape,
             num_cross_attention_heads=self.num_decoder_cross_attention_heads,
-            dropout=self.dropout)
+            dropout=self.dropout,
+            attention_impl=self.decoder_attention_impl,
+            kv_chunk_size=self.kv_chunk_size)
         return PerceiverIO(encoder, decoder)
 
     def restore_pretrained(self, params):

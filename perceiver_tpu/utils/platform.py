@@ -55,7 +55,12 @@ def host_callbacks_supported() -> bool:
         # its PJRT platform_version string is where "axon" shows up.
         return "axon" not in jax.devices()[0].client.platform_version.lower()
     except Exception:
-        return True
+        # fail CLOSED: on a restricted plugin whose client lacks
+        # platform_version, embedding a host callback would kill every
+        # dispatch with UNIMPLEMENTED — the exact failure this helper
+        # exists to prevent — while the silent path only loses an
+        # optional warning (the TB overflow scalar still fires)
+        return False
 
 
 def assume_tpu_target() -> bool:

@@ -96,9 +96,14 @@ def main():
     x = jnp.ones((4096, 4096), jnp.bfloat16)
 
     def chain(x):
+        # divide by a same-dtype scalar: a numpy f32 scalar is not
+        # weak-typed, so dividing by jnp.sqrt(jnp.float32(...)) would
+        # promote x to f32 after the first iteration and run 19 of the
+        # 20 matmuls at the MXU's f32 rate — misreporting bf16 health
+        inv = (1.0 / jnp.sqrt(4096.0)).astype(x.dtype)
         for _ in range(20):
             x = x @ x
-            x = x / jnp.sqrt(jnp.float32(4096))
+            x = x * inv
         return x
 
     t0 = time.perf_counter()

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 trace-driven perf matrix (VERDICT r4 next #1): isolate the
+# contribution of each HBM-traffic lever found in the b256 trace
+# (38 GB/step: packed-CE fp32 logits materialization + fp32 attention
+# weights stored as scan residuals). One pinned bench run per lever
+# combination, all at B=256 / inner=8; winners get re-run bigger by
+# the follow-up sweep. Appends every result line to $OUT.
+set -u
+cd "$(dirname "$0")/.."
+OUT=logs/perf_matrix_r05.jsonl
+mkdir -p logs
+run() { # name, env...
+  local name=$1; shift
+  echo "=== $name ($(date -u +%H:%M:%S)) ===" >&2
+  env BENCH_WAIT=0 BENCH_BATCH=256 BENCH_INNER_STEPS=8 BENCH_DISPATCHES=8 \
+      "$@" timeout 1500 python bench.py 2>logs/perf_matrix_r05_$name.err \
+    | tail -1 | sed "s/^{/{\"exp\": \"$name\", /" > "$OUT.tmp"
+  if [ -s "$OUT.tmp" ]; then cat "$OUT.tmp" >> "$OUT"; cat "$OUT.tmp" >&2
+  else echo "RUN $name PRODUCED NO RESULT (failed or timed out)" >&2; fi
+  rm -f "$OUT.tmp"
+}
+run base              BENCH_LOSS_IMPL=packed
+run remat             BENCH_LOSS_IMPL=packed BENCH_REMAT=1
+run chunked_remat     BENCH_LOSS_IMPL=packed BENCH_REMAT=1 BENCH_ATTN_IMPL=chunked BENCH_DEC_IMPL=chunked
+run pallasce          BENCH_LOSS_IMPL=pallas
+run pallasce_chunked_remat BENCH_LOSS_IMPL=pallas BENCH_REMAT=1 BENCH_ATTN_IMPL=chunked BENCH_DEC_IMPL=chunked
+run pallasce_flash_remat   BENCH_LOSS_IMPL=pallas BENCH_REMAT=1 BENCH_ATTN_IMPL=flash BENCH_DEC_IMPL=flash
+echo "matrix done" >&2

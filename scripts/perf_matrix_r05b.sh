@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 perf matrix phase 2: batch/inner scaling with the phase-1
+# winner (pallas CE + chunked attention + remat). The r04 B=1024
+# regression happened with the materializing impls (fp32 logits +
+# attention weights blowing HBM); with streamed CE and remat the
+# activation footprint is tiny, so batch is the cheapest way to make
+# every small op bigger (the step is a ~5k-op soup of [B,4,64,64]
+# tensors — per-op bytes scale with B at constant op count).
+set -u
+cd "$(dirname "$0")/.."
+OUT=logs/perf_matrix_r05.jsonl
+mkdir -p logs
+run() { # name, env...
+  local name=$1; shift
+  echo "=== $name ($(date -u +%H:%M:%S)) ===" >&2
+  env BENCH_WAIT=0 BENCH_LOSS_IMPL=pallas BENCH_ATTN_IMPL=chunked \
+      BENCH_DEC_IMPL=chunked BENCH_REMAT=1 \
+      "$@" timeout 2400 python bench.py 2>logs/perf_matrix_r05_$name.err \
+    | tail -1 | sed "s/^{/{\"exp\": \"$name\", /" > "$OUT.tmp"
+  if [ -s "$OUT.tmp" ]; then cat "$OUT.tmp" >> "$OUT"; cat "$OUT.tmp" >&2
+  else echo "RUN $name PRODUCED NO RESULT (failed or timed out)" >&2; fi
+  rm -f "$OUT.tmp"
+}
+run pcr_b512_i16  BENCH_BATCH=512  BENCH_INNER_STEPS=16 BENCH_DISPATCHES=6
+run pcr_b1024_i16 BENCH_BATCH=1024 BENCH_INNER_STEPS=16 BENCH_DISPATCHES=4
+run pcr_b2048_i8  BENCH_BATCH=2048 BENCH_INNER_STEPS=8  BENCH_DISPATCHES=4
+run pcr_b4096_i4  BENCH_BATCH=4096 BENCH_INNER_STEPS=4  BENCH_DISPATCHES=4
+echo "matrix phase 2 done" >&2

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-5 perf matrix phase 3: refine around the phase-2 operating
+# point (B=512, pallas CE + chunked + remat = 12.5 steps/s):
+#  - is remat actually helping now that nothing big is materialized?
+#  - does streaming the cross-attention kv in sub-512 chunks (kv=128)
+#    beat the degenerate single-chunk (kv_chunk 1024 >= Lk=512)?
+#  - flash encoder at B=512 (it lost at B=256; bigger rows may flip it)
+#  - inner=32 to amortize the ~75 ms dispatch gap further
+#  - b1024 hang repro with a fast watchdog (r04 regression follow-up)
+set -u
+cd "$(dirname "$0")/.."
+OUT=logs/perf_matrix_r05.jsonl
+mkdir -p logs
+run() { # name, env...
+  local name=$1; shift
+  echo "=== $name ($(date -u +%H:%M:%S)) ===" >&2
+  env BENCH_WAIT=0 BENCH_BATCH=512 BENCH_LOSS_IMPL=pallas \
+      BENCH_ATTN_IMPL=chunked BENCH_DEC_IMPL=chunked BENCH_REMAT=1 \
+      BENCH_INNER_STEPS=16 BENCH_DISPATCHES=6 \
+      "$@" timeout 1800 python bench.py 2>logs/perf_matrix_r05_$name.err \
+    | tail -1 | sed "s/^{/{\"exp\": \"$name\", /" > "$OUT.tmp"
+  if [ -s "$OUT.tmp" ]; then cat "$OUT.tmp" >> "$OUT"; cat "$OUT.tmp" >&2
+  else echo "RUN $name PRODUCED NO RESULT (failed or timed out)" >&2; fi
+  rm -f "$OUT.tmp"
+}
+run pc_noremat_b512     BENCH_REMAT=0
+run pcr_kv128_b512      BENCH_KV_CHUNK=128
+run pfr_flashenc_b512   BENCH_ATTN_IMPL=flash
+run pcr_b512_i32        BENCH_INNER_STEPS=32 BENCH_DISPATCHES=4
+run pcr_b1024_retry     BENCH_BATCH=1024 BENCH_INNER_STEPS=8 BENCH_DISPATCHES=4 BENCH_WATCHDOG=300
+echo "matrix phase 3 done" >&2

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probe the axon tunnel with a real matmul execution until it comes
+# back, then run the phase-3 perf matrix. One probe every 2 min, same
+# cadence the round-2..4 watcher used.
+cd "$(dirname "$0")/.."
+mkdir -p logs
+while true; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((512,512), jnp.bfloat16)
+(x@x).block_until_ready()" >/dev/null 2>&1; then
+    echo "tunnel up at $(date -u +%H:%M:%S)" >> logs/probe_phase3.log
+    bash scripts/perf_matrix_r05c.sh >> logs/perf_matrix_r05c.log 2>&1
+    exit 0
+  fi
+  echo "probe failed at $(date -u +%H:%M:%S)" >> logs/probe_phase3.log
+  sleep 120
+done
