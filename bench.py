@@ -225,8 +225,30 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     # number is invariant in inner_steps).
     _log("tracing + compiling train_steps ...")
     _WATCHDOG.allow(3 * _WATCHDOG.timeout)  # cold compiles are slow
+
+    # graphcheck provenance (ISSUE 1): the dtype audit of the very
+    # lowering being timed, so every result row carries machine-
+    # readable proof of what the matmuls ran in. BENCH_GRAPHCHECK=0
+    # skips it (saves the as_text walk on slow hosts).
+    graphcheck = {}
+
+    def _audit_lowered(lowered):
+        if os.environ.get("BENCH_GRAPHCHECK", "1") == "0":
+            return
+        try:
+            from perceiver_tpu.analysis import hlo
+            s = hlo.dot_flop_summary(list(hlo.iter_dots(
+                lowered.as_text())))
+            graphcheck.update(
+                bf16_flop_fraction=s["bf16_flop_fraction"],
+                flop_weighted_k_ceiling=s["flop_weighted_k_ceiling"],
+                n_dot_general=s["n_dot_general"])
+        except Exception as e:  # noqa: BLE001 — provenance only
+            graphcheck["error"] = f"{type(e).__name__}: {e}"[:160]
+
     step_flops, train_steps = step_flops_and_fn(
-        train_steps, params, opt_state, stacked_batch, key)
+        train_steps, params, opt_state, stacked_batch, key,
+        on_lowered=_audit_lowered)
     _log("compiled; warming up ...")
     # warmup (compile already done when step_flops_and_fn AOT-compiled)
     t_warm = time.perf_counter()
@@ -317,6 +339,9 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
             # numbers were actually measured on, machine-readable
             "platform": jax.devices()[0].platform,
             "device_kind": getattr(jax.devices()[0], "device_kind", None),
+            # lowered-graph dtype provenance (scripts/check.py gates
+            # the same numbers at merge; here they ride the result)
+            "graphcheck": graphcheck or None,
         },
     }
 

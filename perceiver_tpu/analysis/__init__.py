@@ -1,0 +1,40 @@
+"""Static analysis subsystem: lowered-graph passes + source lint.
+
+Two halves, one currency (``report.Violation``):
+
+* Graph passes (``passes``) lower each canonical train step
+  (``targets``) to StableHLO and gate dtype policy, host transfers,
+  buffer donation, and compile-cache closure — the properties TPU
+  performance lives or dies on, checked where they are decided.
+* Source lint (``lint``) walks the AST for the bug classes that
+  should never reach a lowering in the first place.
+
+``scripts/check.py`` is the CLI; ``tests/test_graphcheck.py`` keeps
+every pass honest against seeded violations. See docs/ANALYSIS.md.
+"""
+
+from perceiver_tpu.analysis.report import (  # noqa: F401
+    DtypeAllow,
+    Report,
+    TransferAllow,
+    Violation,
+)
+from perceiver_tpu.analysis.passes import (  # noqa: F401
+    donation_check,
+    dtype_policy,
+    recompile_budget,
+    run_graph_checks,
+    transfer_guard,
+)
+from perceiver_tpu.analysis.targets import (  # noqa: F401
+    CANONICAL_TARGETS,
+    FAST_TARGETS,
+    StepTarget,
+    lower_target,
+    make_train_step,
+)
+from perceiver_tpu.analysis.lint import (  # noqa: F401
+    default_lint_paths,
+    lint_paths,
+    lint_source,
+)

@@ -1,0 +1,168 @@
+"""StableHLO text walker: the shared parsing layer for the graph passes.
+
+Everything operates on ``jitted.lower(...).as_text()`` — the
+pre-optimization StableHLO module, which is platform-independent
+(tracing/lowering needs no chip) and stable enough to gate on: matmul
+operand dtypes, host-transfer custom calls, and input/output aliasing
+are all decided at this level, before XLA's backend passes run.
+
+Parsing is line-oriented regex, not an MLIR parser: the module text is
+machine-generated with one op per line, and the three things the
+passes need (dot shapes/dtypes, custom-call targets, the ``@main``
+signature) are regular. If a jax upgrade changes the printing, the
+self-verifying fixtures in ``tests/test_graphcheck.py`` fail loudly —
+the failure mode is a test break, never a silently-passing gate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Dict, Iterator, List, Tuple
+
+# stablehlo.dot_general with optional batching_dims, capturing the
+# contracting dims and the full (operands) -> result type signature
+_DOT = re.compile(
+    r"stablehlo\.dot_general.*?"
+    r"contracting_dims = \[([0-9, ]*)\] x \[([0-9, ]*)\].*?"
+    r": \(tensor<([^>]+)>, tensor<([^>]+)>\) -> tensor<([^>]+)>")
+
+_CONV = re.compile(
+    r"stablehlo\.convolution.*?"
+    r": \(tensor<([^>]+)>, tensor<([^>]+)>\) -> tensor<([^>]+)>")
+
+_CUSTOM_CALL = re.compile(r"stablehlo\.custom_call @([A-Za-z0-9_.]+)")
+
+_ARG = re.compile(r"%arg\d+: tensor<([^>]+)>(?: loc\([^)]*\))?"
+                  r"(?: \{([^}]*)\})?")
+
+# Ops that move data across the host↔device boundary, or host-compute
+# offload markers. Python host callbacks (jax.debug.print, io_callback,
+# pure_callback) all lower to custom calls named *callback*.
+HOST_TRANSFER_MARKERS = (
+    "stablehlo.infeed",
+    "stablehlo.outfeed",
+    "stablehlo.send",
+    "stablehlo.recv",
+    '_xla_compute_type = "host"',
+)
+_CALLBACK_RE = re.compile(r"custom_call @(\S*callback\S*)\(")
+
+
+def parse_tensor(t: str) -> Tuple[List[int], str]:
+    """``"512x64xbf16"`` → ``([512, 64], "bf16")``; scalars have []."""
+    *dims, dtype = t.split("x")
+    return [int(d) for d in dims], dtype
+
+
+def iter_dots(text: str) -> Iterator[dict]:
+    """Yield one record per ``dot_general``: operand/result shapes,
+    contraction depth K, operand dtype, and FLOPs (2·|out|·K)."""
+    for m in _DOT.finditer(text):
+        lhs_c = [int(x) for x in m.group(1).split(",") if x.strip()]
+        lhs_dims, lhs_dt = parse_tensor(m.group(3))
+        rhs_dims, rhs_dt = parse_tensor(m.group(4))
+        out_dims, out_dt = parse_tensor(m.group(5))
+        k = 1
+        for d in lhs_c:
+            k *= lhs_dims[d]
+        out_elems = 1
+        for d in out_dims:
+            out_elems *= d
+        yield {
+            "op": "dot_general",
+            "lhs": lhs_dims, "rhs": rhs_dims, "out": out_dims,
+            "k": k, "dtype": lhs_dt, "rhs_dtype": rhs_dt,
+            "out_dtype": out_dt,
+            "flops": 2.0 * out_elems * k,
+            "sig": f"({m.group(3)}, {m.group(4)}) -> {m.group(5)}",
+        }
+
+
+def iter_convs(text: str) -> Iterator[dict]:
+    """Yield one record per ``convolution`` (dtype audit only — FLOP
+    attribution for convs stays with XLA's cost analysis)."""
+    for m in _CONV.finditer(text):
+        lhs_dims, lhs_dt = parse_tensor(m.group(1))
+        yield {
+            "op": "convolution",
+            "lhs": lhs_dims, "dtype": lhs_dt, "flops": None,
+            "sig": f"({m.group(1)}, {m.group(2)}) -> {m.group(3)}",
+        }
+
+
+def dot_flop_summary(dots: List[dict], mxu_depth: int = 128) -> dict:
+    """FLOP-weighted aggregates over ``iter_dots`` records: the MXU
+    K-padding ceiling model and the bf16/fp32 FLOP split (the numbers
+    ``scripts/hlo_audit.py`` reports and ``dtype_policy`` gates on)."""
+    total = sum(d["flops"] for d in dots) or 1.0
+    ceiling = sum(d["flops"] * min(d["k"], mxu_depth) / mxu_depth
+                  for d in dots) / total
+    bf16 = sum(d["flops"] for d in dots if "bf16" in d["dtype"]) / total
+    top = sorted(dots, key=lambda d: -d["flops"])[:8]
+    return {
+        "n_dot_general": len(dots),
+        "total_dot_tflops_per_step": round(total / 1e12, 3),
+        "flop_weighted_k_ceiling": round(ceiling, 4),
+        "bf16_flop_fraction": round(bf16, 4),
+        "top_dots": [{"lhs": d["lhs"], "out": d["out"], "k": d["k"],
+                      "dtype": d["dtype"],
+                      "flop_share": round(d["flops"] / total, 4)}
+                     for d in top],
+    }
+
+
+def main_signature(text: str) -> str:
+    """The ``func.func public @main(...)`` line — inputs, per-arg
+    attributes (donation aliasing), and result types."""
+    idx = text.find("@main(")
+    if idx < 0:
+        raise ValueError("lowered module has no public @main function")
+    return text[idx:text.index("\n", idx)]
+
+
+def main_args(text: str) -> List[dict]:
+    """Per-argument records from the @main signature: tensor type and
+    whether lowering aliased it onto an output (actual donation — the
+    ``tf.aliasing_output`` attr jax emits for donated, shape-matched
+    buffers; ``jax.buffer_donor`` marks donated-but-unmatched)."""
+    sig = main_signature(text)
+    # only the input side: results also print as tensor<...> {attrs}
+    sig = sig.split(" -> ")[0]
+    args = []
+    for m in _ARG.finditer(sig):
+        attrs = m.group(2) or ""
+        args.append({
+            "type": m.group(1),
+            "aliased": "tf.aliasing_output" in attrs,
+            "donor_only": "jax.buffer_donor" in attrs,
+        })
+    return args
+
+
+def count_host_markers(text: str) -> Dict[str, int]:
+    """Occurrences of each host-transfer marker in the module text.
+    Callback custom calls are counted under their call-target name."""
+    counts: Dict[str, int] = {}
+    for marker in HOST_TRANSFER_MARKERS:
+        n = text.count(marker)
+        if n:
+            counts[marker] = n
+    for m in _CALLBACK_RE.finditer(text):
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def custom_call_targets(text: str) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for m in _CUSTOM_CALL.finditer(text):
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def module_fingerprint(text: str) -> str:
+    """Stable fingerprint of the module's compilation-cache-relevant
+    interface: the @main input/result signature (shapes + dtypes +
+    donation layout). Two lowerings of "the same" step that disagree
+    here WILL be two compile-cache entries on the chip."""
+    return hashlib.sha256(main_signature(text).encode()).hexdigest()[:16]

@@ -1,0 +1,418 @@
+"""Source-level (AST) linter with JAX-specific rules.
+
+The graph passes catch what made it into the lowered module; these
+rules catch what never should have been written — host syncs and
+Python-time effects inside traced code, numpy/jax.numpy mixing in ops
+code, and enum-like config fields without config-time validation.
+
+Rules (names are the ``check`` field of emitted violations):
+
+``jit-host-sync``
+    Inside jit-traced functions: ``.item()`` calls, ``float()``/
+    ``int()``/``bool()`` applied to traced function parameters, and
+    ``np.*`` calls (which force the tracer to concretize — a trace
+    error at best, a silent host round-trip at worst).
+
+``jit-python-rng-time``
+    ``time.*``, ``random.*``, ``np.random.*``, ``datetime.*.now`` calls
+    inside jit-traced functions: they run once at trace time and
+    freeze into the compiled graph as constants.
+
+``ops-numpy-mix``
+    A module under ``perceiver_tpu/ops/`` importing both ``numpy`` and
+    ``jax.numpy`` at top level. Host-side precompute belongs in
+    np-only modules (see ``ops/fourier.py``); traced code in jnp-only
+    modules — one module doing both is where np-on-traced-values bugs
+    breed.
+
+``impl-field-validation``
+    A dataclass field named ``*_impl`` (the repo's string-enum
+    convention) whose defining class has no domain validation in
+    ``__post_init__``. The canonical form is
+    ``if self.<field> not in <valid set>: raise`` — a positive ``in``
+    test conjoined with other conditions (e.g. the dropout-support
+    guards) is a feature check, not domain validation, and does not
+    count. An unvalidated value fails deep inside a jit trace instead
+    of at config time (ADVICE r5 on ``tasks/base.py``).
+
+Tracing detection is local and conservative: functions decorated with
+``jax.jit`` / ``partial(jax.jit, ...)``, functions passed to a
+``jax.jit(...)`` call anywhere in the module, and everything nested
+inside them. Cross-module propagation (a jitted caller invoking a
+helper from another file) is out of scope — the graph passes cover
+that end via the lowered module itself.
+
+Suppress any finding by putting ``graphcheck: ignore`` in a comment on
+the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set
+
+from perceiver_tpu.analysis.report import Report, Violation
+
+SUPPRESS_MARKER = "graphcheck: ignore"
+
+_TIME_CALLS = {"time", "perf_counter", "monotonic", "time_ns",
+               "perf_counter_ns", "monotonic_ns", "process_time"}
+# attribute accesses that read static metadata, not traced values
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return False
+
+
+def _is_partial_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "partial"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "partial"
+    return False
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            return True
+        if _is_partial_expr(dec.func):
+            return any(_is_jit_expr(a) for a in dec.args)
+    return False
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    """``np.random.normal`` → ``"np"``; bare names → the name."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return list(reversed(parts))
+
+
+class _Imports(ast.NodeVisitor):
+    """Module alias map for the handful of modules the rules care
+    about. ``top_level`` records what the module imports at its top
+    scope (for the ops mixing rule)."""
+
+    def __init__(self):
+        self.numpy: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.time: Set[str] = set()
+        self.random: Set[str] = set()
+        self.datetime: Set[str] = set()
+        self.top_level: Set[str] = set()
+        self._depth = 0
+
+    def visit_FunctionDef(self, node):
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _record(self, module: str, alias: str) -> None:
+        bucket = {"numpy": self.numpy, "jax.numpy": self.jnp,
+                  "time": self.time, "random": self.random,
+                  "datetime": self.datetime}.get(module)
+        if bucket is not None:
+            bucket.add(alias)
+            if self._depth == 0:
+                self.top_level.add(module)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self._record(a.name, a.asname or a.name.split(".")[0])
+
+    def visit_ImportFrom(self, node):
+        if node.module == "jax":
+            for a in node.names:
+                if a.name == "numpy":
+                    self._record("jax.numpy", a.asname or "numpy")
+
+
+def _jit_called_names(tree: ast.AST) -> Set[str]:
+    """Function names passed to a ``jax.jit(fn, ...)``-style call."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            names.add(node.args[0].id)
+    return names
+
+
+def _traced_param_names(node: ast.AST) -> Iterable[str]:
+    a = node.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        if arg.arg != "self":
+            yield arg.arg
+
+
+def _names_outside_static_attrs(node: ast.AST) -> Set[str]:
+    """Names referenced in ``node``, skipping subtrees hanging off
+    static-metadata attributes (``x.shape[0]`` reads no traced data)."""
+    found: Set[str] = set()
+
+    def walk(n):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            found.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    walk(node)
+    return found
+
+
+class _TracedChecker:
+    """Applies the traced-context rules inside one jit-traced function
+    (and its nested defs, whose params are traced too)."""
+
+    def __init__(self, imports: _Imports, path: str):
+        self.imports = imports
+        self.path = path
+        self.violations: List[Violation] = []
+
+    def _add(self, check: str, node: ast.AST, message: str) -> None:
+        self.violations.append(Violation(
+            check=check, where=f"{self.path}:{node.lineno}",
+            message=message))
+
+    def check(self, fn: ast.AST) -> List[Violation]:
+        self._walk(fn, set(_traced_param_names(fn)))
+        return self.violations
+
+    def _walk(self, node: ast.AST, params: Set[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_params = params
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_params = params | set(_traced_param_names(child))
+            if isinstance(child, ast.Call):
+                self._check_call(child, params)
+            self._walk(child, child_params)
+
+    def _check_call(self, call: ast.Call, params: Set[str]) -> None:
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr == "item" \
+                and not call.args:
+            self._add("jit-host-sync", call,
+                      ".item() inside a jit-traced function — a "
+                      "device→host sync that fails under trace; thread "
+                      "the value out of the jitted computation instead")
+            return
+        if isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                      "bool") \
+                and call.args:
+            touched = _names_outside_static_attrs(call.args[0]) & params
+            if touched:
+                self._add("jit-host-sync", call,
+                          f"{func.id}() applied to traced value(s) "
+                          f"{sorted(touched)} inside a jit-traced "
+                          "function — concretization error under "
+                          "trace; use jnp casts/ops instead")
+            return
+        root = _attr_root(func)
+        if root is None:
+            return
+        chain = _attr_chain(func)
+        if root in self.imports.numpy:
+            if len(chain) >= 3 and chain[1] == "random":
+                self._add("jit-python-rng-time", call,
+                          f"{'.'.join(chain)}() inside a jit-traced "
+                          "function — host RNG runs once at trace time "
+                          "and freezes; use jax.random with a threaded "
+                          "key")
+            else:
+                self._add("jit-host-sync", call,
+                          f"{'.'.join(chain)}() inside a jit-traced "
+                          "function — numpy concretizes traced values; "
+                          "use the jax.numpy equivalent")
+            return
+        if root in self.imports.time and chain[-1] in _TIME_CALLS:
+            self._add("jit-python-rng-time", call,
+                      f"{'.'.join(chain)}() inside a jit-traced "
+                      "function — evaluated once at trace time, then "
+                      "constant; time outside the jitted step")
+            return
+        if root in self.imports.random:
+            self._add("jit-python-rng-time", call,
+                      f"{'.'.join(chain)}() inside a jit-traced "
+                      "function — Python RNG runs at trace time and "
+                      "freezes; use jax.random with a threaded key")
+            return
+        if root in self.imports.datetime and chain[-1] in ("now",
+                                                           "utcnow",
+                                                           "today"):
+            self._add("jit-python-rng-time", call,
+                      f"{'.'.join(chain)}() inside a jit-traced "
+                      "function — trace-time constant; stamp outside "
+                      "the jitted step")
+
+
+def _is_dataclass_decorator(dec: ast.AST) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(target, ast.Name):
+        return target.id == "dataclass"
+    if isinstance(target, ast.Attribute):
+        return target.attr == "dataclass"
+    return False
+
+
+def _check_impl_fields(cls: ast.ClassDef, path: str) -> List[Violation]:
+    fields = [(stmt.target.id, stmt.lineno) for stmt in cls.body
+              if isinstance(stmt, ast.AnnAssign)
+              and isinstance(stmt.target, ast.Name)
+              and stmt.target.id.endswith("_impl")]
+    if not fields:
+        return []
+    post = next((stmt for stmt in cls.body
+                 if isinstance(stmt, ast.FunctionDef)
+                 and stmt.name == "__post_init__"), None)
+    validated: Set[str] = set()
+    if post is not None:
+        # only the `self.<field> not in <valid set>` form counts: a
+        # positive `in` test is how the feature guards are phrased
+        # (e.g. "dropout unsupported for impl in (...)"), which must
+        # not satisfy the domain-validation requirement
+        for node in ast.walk(post):
+            if isinstance(node, ast.Compare) and any(
+                    isinstance(op, ast.NotIn) for op in node.ops):
+                left = node.left
+                if isinstance(left, ast.Attribute) \
+                        and isinstance(left.value, ast.Name) \
+                        and left.value.id == "self":
+                    validated.add(left.attr)
+    out = []
+    for name, lineno in fields:
+        if name not in validated:
+            out.append(Violation(
+                check="impl-field-validation", where=f"{path}:{lineno}",
+                message=f"dataclass {cls.name}.{name} is an enum-like "
+                        "impl field with no membership validation in "
+                        f"{cls.name}.__post_init__ — an invalid value "
+                        "only fails deep inside a jit trace; validate "
+                        "at config time"))
+    return out
+
+
+def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
+    """Lint one module's source. ``path`` is used for reporting and
+    for the ops-scoped rule (a path containing ``/ops/``)."""
+    tree = ast.parse(src, filename=path)
+    imports = _Imports()
+    imports.visit(tree)
+    violations: List[Violation] = []
+
+    norm = path.replace(os.sep, "/")
+    if "/ops/" in norm and {"numpy", "jax.numpy"} <= imports.top_level:
+        lineno = next((n.lineno for n in tree.body
+                       if isinstance(n, (ast.Import, ast.ImportFrom))), 1)
+        violations.append(Violation(
+            check="ops-numpy-mix", where=f"{path}:{lineno}",
+            message="ops module imports both numpy and jax.numpy at "
+                    "top level — keep host-side precompute in np-only "
+                    "modules (ops/fourier.py pattern) and traced code "
+                    "jnp-only, or mark the line 'graphcheck: ignore' "
+                    "with a reason"))
+
+    jit_names = _jit_called_names(tree)
+    traced_roots = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in jit_names or any(
+                    _is_jit_decorator(d) for d in node.decorator_list):
+                traced_roots.append(node)
+    # drop roots nested inside another root (checked once, outermost)
+    covered = set()
+    for root in traced_roots:
+        for sub in ast.walk(root):
+            if sub is not root and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                covered.add(sub)
+    for root in traced_roots:
+        if root not in covered:
+            violations.extend(
+                _TracedChecker(imports, path).check(root))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and any(
+                _is_dataclass_decorator(d) for d in node.decorator_list):
+            violations.extend(_check_impl_fields(node, path))
+
+    # per-line suppression
+    lines = src.splitlines()
+    kept = []
+    for v in violations:
+        try:
+            lineno = int(v.where.rsplit(":", 1)[1])
+            if SUPPRESS_MARKER in lines[lineno - 1]:
+                continue
+        except (IndexError, ValueError):
+            pass
+        kept.append(v)
+    return kept
+
+
+ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
+             "impl-field-validation")
+
+
+def lint_paths(paths: Iterable[str]) -> Report:
+    """Lint every ``.py`` file under the given files/directories."""
+    report = Report()
+    for rule in ALL_RULES:
+        report.ran(rule)
+    for path in _expand(paths):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            report.extend(lint_source(src, path))
+        except SyntaxError as e:
+            report.add(Violation(
+                check="lint-parse", where=f"{path}:{e.lineno or 0}",
+                message=f"could not parse: {e.msg}"))
+    return report
+
+
+def _expand(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+_REPO_LINT_DEFAULTS = ("perceiver_tpu", "scripts", "bench.py", "run.py")
+
+
+def default_lint_paths(repo_root: str) -> List[str]:
+    """The tree ``scripts/check.py`` lints by default: the package,
+    the scripts, and the entry points. Tests are excluded on purpose —
+    they host-sync deliberately to assert on device values."""
+    return [os.path.join(repo_root, p) for p in _REPO_LINT_DEFAULTS
+            if os.path.exists(os.path.join(repo_root, p))]

@@ -1,0 +1,183 @@
+"""Lowered-graph passes: dtype policy, host transfers, donation, and
+compile-cache closure, each over the StableHLO of a canonical train
+step (``targets.py``).
+
+These gate the exact defect classes previous rounds found by hand:
+the round-4 HLO audit caught 9.1% of step FLOPs silently running at
+the fp32 MXU rate (dtype_policy), and the axon runtime rejects host
+callbacks at dispatch time (transfer_guard) — both are properties of
+the lowered module, so they are checked on the lowered module.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from perceiver_tpu.analysis import hlo
+from perceiver_tpu.analysis.report import (
+    DtypeAllow,
+    Report,
+    TransferAllow,
+    Violation,
+    apply_dtype_allowlist,
+)
+from perceiver_tpu.analysis.targets import (
+    CANONICAL_TARGETS,
+    LoweredStep,
+    StepTarget,
+    lower_target,
+)
+
+# operand dtypes the MXU runs at reduced rate — any matmul-class op
+# carrying one of these must be allowlisted with a reason
+_SLOW_MATMUL_DTYPES = ("f32", "f64")
+
+
+def dtype_policy(text: str, *, where: str,
+                 allowlist: Sequence[DtypeAllow] = (),
+                 require_full_bf16: bool = False,
+                 ) -> Tuple[List[Violation], dict]:
+    """No fp32/fp64 ``dot_general``/``convolution`` outside the
+    allowlist; headline configs additionally pin the FLOP-weighted
+    bf16 fraction at exactly 1.0 (the round-4 audit's regression)."""
+    violations = []
+    dots = list(hlo.iter_dots(text))
+    slow = [d for d in dots + list(hlo.iter_convs(text))
+            if d["dtype"] in _SLOW_MATMUL_DTYPES]
+    _, violating = apply_dtype_allowlist(slow, tuple(allowlist))
+    total = sum(d["flops"] for d in dots) or 1.0
+    for rec in violating:
+        share = (f", {100 * rec['flops'] / total:.1f}% of step dot-FLOPs"
+                 if rec.get("flops") else "")
+        violations.append(Violation(
+            check="dtype_policy", where=where,
+            message=f"{rec['dtype']} {rec['op']} {rec['sig']}{share} — "
+                    "matmuls must run in bf16 (Policy.bf16 compute "
+                    "dtype); cast the operands or add a reasoned "
+                    "DtypeAllow to the target"))
+    summary = hlo.dot_flop_summary(dots)
+    if require_full_bf16 and summary["bf16_flop_fraction"] != 1.0:
+        violations.append(Violation(
+            check="dtype_policy", where=where,
+            message=f"bf16_flop_fraction = "
+                    f"{summary['bf16_flop_fraction']} != 1.0 on a "
+                    "headline config — some dot FLOPs run at the fp32 "
+                    "MXU rate (the round-4 9.1% regression class)"))
+    return violations, summary
+
+
+def transfer_guard(text: str, *, where: str,
+                   allowlist: Sequence[TransferAllow] = (),
+                   ) -> List[Violation]:
+    """No host↔device transfers inside the jitted step: infeed/outfeed/
+    send/recv, host-compute offload, or host-callback custom calls.
+    The axon TPU runtime rejects callbacks at dispatch time, so one in
+    the step graph is a guaranteed runtime failure, not a slowdown."""
+    violations = []
+    budgets = {a.marker: a.max_count for a in allowlist}
+    for marker, count in sorted(hlo.count_host_markers(text).items()):
+        allowed = budgets.get(marker, 0)
+        if count > allowed:
+            over = count - allowed
+            violations.append(Violation(
+                check="transfer_guard", where=where,
+                message=f"{over} unallowlisted host-transfer marker(s) "
+                        f"{marker!r} in the jitted step (total {count}, "
+                        f"allowlisted {allowed}) — host syncs stall the "
+                        "device pipeline and the axon runtime rejects "
+                        "callbacks outright"))
+    return violations
+
+
+def donation_check(text: str, *, where: str,
+                   expected_donated: int) -> List[Violation]:
+    """Train-state buffers must be donated AND actually aliased onto
+    outputs by lowering (``tf.aliasing_output``). A donated-but-
+    unaliased buffer (``jax.buffer_donor``) doubles its HBM footprint
+    exactly like forgetting ``donate_argnums``."""
+    args = hlo.main_args(text)
+    aliased = sum(1 for a in args if a["aliased"])
+    donor_only = [a for a in args if a["donor_only"]]
+    violations = []
+    if aliased < expected_donated:
+        violations.append(Violation(
+            check="donation_check", where=where,
+            message=f"only {aliased}/{expected_donated} train-state "
+                    "buffers are donated+aliased in the lowered step — "
+                    "params/optimizer state must ride donate_argnums "
+                    "or peak HBM carries two copies of the state"))
+    for a in donor_only:
+        violations.append(Violation(
+            check="donation_check", where=where,
+            message=f"buffer tensor<{a['type']}> is marked donated but "
+                    "lowering found no matching output to alias "
+                    "(shape/dtype drift between input and output state)"))
+    return violations
+
+
+def recompile_budget(target: StepTarget,
+                     first: Optional[LoweredStep] = None,
+                     ) -> Tuple[List[Violation], str]:
+    """The compilation-cache key set must be closed: rebuilding a
+    target's task + batch from scratch and re-lowering must reproduce
+    the identical step signature (shapes, dtypes, donation layout) and
+    an equal task hash. Any drift is a recompile per rebuild on the
+    chip — the silent multi-minute stall class."""
+    violations = []
+    if first is None:
+        first = lower_target(target)
+    second = lower_target(target)
+    fp1 = hlo.module_fingerprint(first.text)
+    fp2 = hlo.module_fingerprint(second.text)
+    if fp1 != fp2:
+        violations.append(Violation(
+            check="recompile_budget", where=target.name,
+            message=f"independent rebuilds lowered to different step "
+                    f"signatures ({fp1} vs {fp2}) — shape/dtype drift "
+                    "in the task config or batch builder means every "
+                    "rebuild recompiles"))
+    if first.task_hash != second.task_hash:
+        violations.append(Violation(
+            check="recompile_budget", where=target.name,
+            message="task config hash differs across rebuilds — the "
+                    "config dataclass carries unstable state, so jit "
+                    "treats each instance as a new cache key"))
+    return violations, fp1
+
+
+def run_graph_checks(targets: Sequence[StepTarget] = CANONICAL_TARGETS,
+                     *, recompile: bool = True) -> Report:
+    """Lower each target and run all graph passes. ``recompile=False``
+    skips the second lowering per target (the fast tier-1 subset)."""
+    report = Report()
+    fingerprints = {}
+    for target in targets:
+        lowered = lower_target(target)
+        vs, _summary = dtype_policy(
+            lowered.text, where=target.name,
+            allowlist=target.dtype_allow,
+            require_full_bf16=target.headline)
+        report.extend(vs)
+        report.ran("dtype_policy")
+        report.extend(transfer_guard(
+            lowered.text, where=target.name,
+            allowlist=target.transfer_allow))
+        report.ran("transfer_guard")
+        report.extend(donation_check(
+            lowered.text, where=target.name,
+            expected_donated=lowered.expected_donated))
+        report.ran("donation_check")
+        if recompile:
+            vs, fp = recompile_budget(target, first=lowered)
+            report.extend(vs)
+            report.ran("recompile_budget")
+            fingerprints[target.name] = fp
+    if recompile and len(set(fingerprints.values())) < len(fingerprints):
+        dupes = {n: fp for n, fp in fingerprints.items()
+                 if list(fingerprints.values()).count(fp) > 1}
+        report.add(Violation(
+            check="recompile_budget", where=",".join(sorted(dupes)),
+            message=f"distinct targets share a step signature {dupes} — "
+                    "two canonical configs collapsed onto one compile "
+                    "key, so one of them is not being checked"))
+    return report

@@ -1,0 +1,123 @@
+"""Violation records and reports shared by both analysis halves.
+
+Every graph pass and lint rule reduces to the same currency: a
+``Violation`` naming the pass/rule, where it fired, and why. A
+``Report`` aggregates them; ``scripts/check.py`` turns a non-empty
+report into a non-zero exit, which is the whole gating contract —
+there is deliberately no warning level, because a warning that does
+not fail the merge is re-discovered by hand a round later (the exact
+failure mode this subsystem exists to end; see ISSUE 1 / ADVICE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One check failure.
+
+    check:   pass or lint-rule name (``dtype_policy``, ``jit-host-sync``).
+    where:   location — ``file.py:line`` for lint, target name for
+             graph passes.
+    message: what is wrong and, where possible, what to do instead.
+    """
+
+    check: str
+    where: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.where}: [{self.check}] {self.message}"
+
+
+@dataclasses.dataclass
+class Report:
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+    # passes/rules that actually ran (a report that is empty because
+    # nothing executed must not read as a clean tree)
+    checks_run: List[str] = dataclasses.field(default_factory=list)
+
+    def add(self, violation: Violation) -> None:
+        self.violations.append(violation)
+
+    def extend(self, violations) -> None:
+        self.violations.extend(violations)
+
+    def ran(self, check: str) -> None:
+        if check not in self.checks_run:
+            self.checks_run.append(check)
+
+    def merge(self, other: "Report") -> None:
+        self.extend(other.violations)
+        for c in other.checks_run:
+            self.ran(c)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def format(self) -> str:
+        lines = [v.format() for v in self.violations]
+        lines.append(f"{len(self.violations)} violation(s) from "
+                     f"{len(self.checks_run)} check(s): "
+                     f"{', '.join(self.checks_run) or '(none ran)'}")
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "checks_run": list(self.checks_run),
+            "violations": [dataclasses.asdict(v) for v in self.violations],
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypeAllow:
+    """One ``dtype_policy`` allowlist entry: permits up to ``max_count``
+    matmul-class ops with the given operand dtype, optionally narrowed
+    by a substring of the op's tensor-type signature. Every entry
+    must carry a human reason — the allowlist IS the audit trail."""
+
+    dtype: str                      # e.g. "f32"
+    reason: str
+    max_count: int = 1
+    type_substr: Optional[str] = None
+
+    def matches(self, dtype: str, type_sig: str) -> bool:
+        if dtype != self.dtype:
+            return False
+        return self.type_substr is None or self.type_substr in type_sig
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferAllow:
+    """One ``transfer_guard`` allowlist entry: permits up to
+    ``max_count`` occurrences of a host-transfer marker (custom-call
+    target or op name substring) with a recorded reason."""
+
+    marker: str
+    reason: str
+    max_count: int = 1
+
+
+def apply_dtype_allowlist(records: List[dict],
+                          allowlist: Tuple[DtypeAllow, ...]):
+    """Split fp32+ matmul records into (allowed, violating) under the
+    allowlist's per-entry count budgets."""
+    budgets = {id(a): a.max_count for a in allowlist}
+    allowed, violating = [], []
+    for rec in records:
+        hit = None
+        for a in allowlist:
+            if budgets[id(a)] > 0 and a.matches(rec["dtype"], rec["sig"]):
+                hit = a
+                break
+        if hit is not None:
+            budgets[id(hit)] -= 1
+            allowed.append(rec)
+        else:
+            violating.append(rec)
+    return allowed, violating

@@ -1,0 +1,208 @@
+"""Canonical lowering targets: one jitted train step per task, at the
+shapes the benchmarks and runbooks actually pin.
+
+Each target rebuilds, from scratch, the exact step ``bench.py`` times
+(forward + backward + AdamW, params and optimizer state donated) and
+lowers it on the CPU backend — StableHLO lowering is platform-
+independent, so the dtype/transfer/donation properties gated here are
+the ones the chip will see. The targets also define the per-config
+allowlists: every exception is written down next to the config it
+covers, with a reason (the allowlist is the audit trail, not an
+escape hatch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Tuple
+
+from perceiver_tpu.analysis.report import DtypeAllow, TransferAllow
+
+# The packed-CE overflow warning (tasks/mlm.py) lowers to one host
+# callback on backends that support them; on the axon TPU runtime the
+# host_callbacks_supported() gate removes it entirely, so the CPU-side
+# lowering legitimately carries up to one callback custom call per
+# traced loss (primal only — debug_print has no transpose).
+_MLM_OVERFLOW_CALLBACK = (
+    TransferAllow(
+        marker="xla_python_cpu_callback", max_count=1,
+        reason="packed-CE overflow warning (tasks/mlm.py) — "
+               "observability-only debug print, removed on the TPU "
+               "runtime by host_callbacks_supported()"),
+    TransferAllow(
+        marker="xla_ffi_python_cpu_callback", max_count=1,
+        reason="same warning under the FFI callback lowering newer "
+               "jax versions emit"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepTarget:
+    """One canonical (task config, input shapes) pair to lower and gate.
+
+    ``build`` returns a fresh ``(task, batch)`` every call — the
+    recompile-budget pass relies on independent rebuilds producing
+    byte-identical step signatures.
+    """
+
+    name: str
+    build: Callable[[], Tuple[object, dict]]
+    # headline targets additionally assert bf16_flop_fraction == 1.0
+    headline: bool = False
+    transfer_allow: Tuple[TransferAllow, ...] = ()
+    dtype_allow: Tuple[DtypeAllow, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class LoweredStep:
+    """A lowered target: the StableHLO text plus the donation contract
+    derived from the live arguments."""
+
+    target: StepTarget
+    text: str
+    # leaves of (params, opt_state) — every one must be donated AND
+    # aliased onto an output by lowering
+    expected_donated: int
+    task_hash: int
+
+
+def make_train_step(task, batch):
+    """The canonical single-optimizer-step jit: forward + backward +
+    AdamW with (params, opt_state) donated — the step every benchmark
+    and the trainer's hot loop run. Returns ``(jitted_fn, args)``."""
+    import jax
+    import optax
+
+    from perceiver_tpu.ops.policy import Policy
+
+    model = task.build()
+    policy = Policy.bf16()
+    params = model.init(jax.random.key(0))
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, batch_i, key):
+        def loss_fn(p):
+            loss, _ = task.loss_and_metrics(
+                model, p, batch_i, rng=key, deterministic=False,
+                policy=policy)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return train_step, (params, opt_state, batch, jax.random.key(1))
+
+
+def lower_target(target: StepTarget) -> LoweredStep:
+    """Build the target's task + batch, lower its train step, and
+    package the properties the graph passes gate on."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    task, batch = target.build()
+    step, args = make_train_step(task, batch)
+    params, opt_state = args[0], args[1]
+    expected = len(jax.tree_util.tree_leaves((params, opt_state)))
+    text = step.lower(*args).as_text()
+    return LoweredStep(target=target, text=text,
+                       expected_donated=expected, task_hash=hash(task))
+
+
+# --------------------------------------------------------------------------
+# Canonical configs. Shapes mirror bench.py's pinned/headline rungs and
+# the runbook configs; vocab/seq match the BASELINE MLM recipe.
+
+def _build_mlm(batch: int = 512, channels: int = 64, seq_len: int = 512,
+               vocab: int = 10003, loss_impl: str = "packed"):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    task = MaskedLanguageModelTask(
+        vocab_size=vocab, max_seq_len=seq_len, loss_impl=loss_impl,
+        num_latent_channels=channels)
+    rng = np.random.default_rng(0)
+    data = {
+        "input_ids": jnp.asarray(
+            rng.integers(3, vocab, (batch, seq_len)), jnp.int32),
+        "pad_mask": jnp.zeros((batch, seq_len), bool),
+    }
+    return task, data
+
+
+def _build_text_clf(batch: int = 64, seq_len: int = 512,
+                    vocab: int = 10003):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_tpu.tasks import TextClassifierTask
+
+    task = TextClassifierTask(vocab_size=vocab, max_seq_len=seq_len)
+    rng = np.random.default_rng(0)
+    data = {
+        "input_ids": jnp.asarray(
+            rng.integers(3, vocab, (batch, seq_len)), jnp.int32),
+        "pad_mask": jnp.zeros((batch, seq_len), bool),
+        "label": jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32),
+    }
+    return task, data
+
+
+def _build_img_clf(batch: int = 512):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_tpu.tasks import ImageClassifierTask
+
+    task = ImageClassifierTask(
+        image_shape=(28, 28, 1), num_classes=10, num_frequency_bands=32,
+        num_latents=32, num_latent_channels=128, num_encoder_layers=3,
+        num_encoder_self_attention_layers_per_block=3,
+        num_decoder_cross_attention_heads=1)
+    rng = np.random.default_rng(0)
+    data = {
+        "image": jnp.asarray(
+            rng.normal(0, 1, (batch, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, (batch,)), jnp.int32),
+    }
+    return task, data
+
+
+def _build_seg(batch: int = 1, side: int = 512):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_tpu.tasks import SegmentationTask
+
+    task = SegmentationTask(image_shape=(side, side, 1),
+                            query_chunk_size=min(16384, side * side))
+    rng = np.random.default_rng(0)
+    data = {
+        "image": jnp.asarray(
+            rng.random((batch, side, side, 1)) *
+            (rng.random((batch, side, side, 1)) < 0.01), jnp.float32),
+        "label": jnp.asarray(
+            rng.integers(0, 3, (batch, side, side)), jnp.int32),
+    }
+    return task, data
+
+
+# The headline MLM rung (bench.py _LADDER[0]: B=512/C=64/packed) plus
+# one target per remaining task at its canonical shapes. "fast" targets
+# keep tracing under a few seconds for the tier-1 subset; --all adds
+# the expensive ones (the 262k-query segmentation decoder).
+CANONICAL_TARGETS = (
+    StepTarget(name="mlm_b512_c64_packed", build=_build_mlm,
+               headline=True, transfer_allow=_MLM_OVERFLOW_CALLBACK),
+    StepTarget(name="text_clf_b64", build=_build_text_clf),
+    StepTarget(name="img_clf_b512", build=_build_img_clf),
+    StepTarget(name="seg_512x512_b1", build=_build_seg),
+)
+
+FAST_TARGETS = tuple(t for t in CANONICAL_TARGETS
+                     if t.name != "seg_512x512_b1")

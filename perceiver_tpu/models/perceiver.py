@@ -44,6 +44,8 @@ import jax.numpy as jnp
 
 from perceiver_tpu.models.masking import TextMasking
 from perceiver_tpu.ops.attention import (
+    ATTENTION_IMPLS,
+    DECODER_ATTENTION_IMPLS,
     cross_attention_init,
     cross_attention_apply,
     self_attention_init,
@@ -186,6 +188,13 @@ class PerceiverEncoder:
     # the seq-2048 / 12-block configs (BASELINE.md configs[4]).
     remat: bool = False
 
+    def __post_init__(self):
+        # fail at model build, not deep inside a jit trace
+        if self.attention_impl not in ATTENTION_IMPLS:
+            raise ValueError(
+                f"unknown attention_impl {self.attention_impl!r}; "
+                f"expected one of {ATTENTION_IMPLS}")
+
     def _layer_init(self, key):
         kc, ks = jax.random.split(key)
         return {
@@ -283,6 +292,14 @@ class PerceiverDecoder:
     # 262k-query config.
     attention_impl: Optional[str] = None
     kv_chunk_size: int = 1024
+
+    def __post_init__(self):
+        if self.attention_impl not in DECODER_ATTENTION_IMPLS:
+            raise ValueError(
+                f"unknown decoder attention_impl "
+                f"{self.attention_impl!r}; expected one of "
+                f"{DECODER_ATTENTION_IMPLS} (the SPMD impls shard the "
+                "encoder token axis and do not apply to output queries)")
 
     def init(self, key):
         k_out, k_query, k_cross = jax.random.split(key, 3)

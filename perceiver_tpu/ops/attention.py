@@ -117,7 +117,15 @@ def _qk_dot_bwd(res, g):
 _qk_dot.defvjp(_qk_dot_fwd, _qk_dot_bwd)
 
 
-_SPMD_IMPLS = ("seqpar", "ring", "ulysses")
+# The attention-kernel domain, the single source of truth for the
+# config-time membership validation in models/perceiver.py and
+# tasks/base.py (and the trace-time check in mha_apply below).
+SPMD_IMPLS = ("seqpar", "ring", "ulysses")
+ATTENTION_IMPLS = (None, "einsum", "chunked", "flash") + SPMD_IMPLS
+# output-query ← latent cross-attention: the SPMD impls shard the
+# encoder token axis and do not apply (tasks/base.py docstring)
+DECODER_ATTENTION_IMPLS = (None, "einsum", "chunked", "flash")
+_SPMD_IMPLS = SPMD_IMPLS
 
 
 def mha_apply(params, q, k, v, *, num_heads: int,
@@ -142,7 +150,7 @@ def mha_apply(params, q, k, v, *, num_heads: int,
     is laid out (batch_axis may be None).
     Returns (B, Lq, q_dim).
     """
-    if impl not in (None, "einsum", "chunked", "flash", *_SPMD_IMPLS):
+    if impl not in ATTENTION_IMPLS:
         raise ValueError(
             f"unknown attention impl {impl!r}; expected None, 'einsum', "
             "'chunked', 'flash', 'seqpar', 'ring', or 'ulysses'")

@@ -61,9 +61,26 @@ class TaskConfig:
         return restore_from_torch(self.torch_ckpt, template=params)
 
     def __post_init__(self):
-        # fail at config time, not deep inside a jit trace: attention-
-        # weight dropout is only implemented for the einsum and chunked
-        # kernels (chunked streams it — see ops/chunked_attention.py)
+        from perceiver_tpu.ops.attention import (
+            ATTENTION_IMPLS,
+            DECODER_ATTENTION_IMPLS,
+        )
+        # fail at config time, not deep inside a jit trace — first the
+        # domain checks, then the cross-field feature guards
+        if self.attention_impl not in ATTENTION_IMPLS:
+            raise ValueError(
+                f"unknown attention_impl {self.attention_impl!r}; "
+                f"expected one of {ATTENTION_IMPLS}")
+        if self.decoder_attention_impl not in DECODER_ATTENTION_IMPLS:
+            raise ValueError(
+                f"decoder_attention_impl="
+                f"{self.decoder_attention_impl!r} — the decoder "
+                "cross-attention supports None, 'einsum', 'chunked', or "
+                "'flash' (the SPMD impls shard the encoder token axis "
+                "and do not apply to output queries)")
+        # attention-weight dropout is only implemented for the einsum
+        # and chunked kernels (chunked streams it — see
+        # ops/chunked_attention.py)
         if self.dropout > 0.0 and self.attention_impl in (
                 "flash", "seqpar", "ring", "ulysses"):
             raise ValueError(
@@ -71,14 +88,6 @@ class TaskConfig:
                 f"support attention-weight dropout "
                 f"(dropout={self.dropout}); use attention_impl="
                 "'einsum' or 'chunked', or set --model.dropout=0")
-        if self.decoder_attention_impl not in (None, "einsum", "chunked",
-                                               "flash"):
-            raise ValueError(
-                f"decoder_attention_impl="
-                f"{self.decoder_attention_impl!r} — the decoder "
-                "cross-attention supports None, 'einsum', 'chunked', or "
-                "'flash' (the SPMD impls shard the encoder token axis "
-                "and do not apply to output queries)")
         if self.dropout > 0.0 and self.decoder_attention_impl == "flash":
             raise ValueError(
                 "decoder_attention_impl='flash' does not support "

@@ -66,7 +66,8 @@ def lowered_step_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
         return None
 
 
-def step_flops_and_fn(jitted_fn, *args, num_devices: int = 1, **kwargs):
+def step_flops_and_fn(jitted_fn, *args, num_devices: int = 1,
+                      on_lowered=None, **kwargs):
     """Returns ``(global_flops, fn)`` where ``fn`` is what the caller
     should invoke from now on.
 
@@ -79,11 +80,20 @@ def step_flops_and_fn(jitted_fn, *args, num_devices: int = 1, **kwargs):
     count is scaled by ``num_devices`` (the devices the computation
     spans) to stay global. AOT executables require argument shapes and
     shardings to stay fixed, which the static-shape input pipeline
-    guarantees."""
+    guarantees.
+
+    ``on_lowered``, when given, receives the ``Lowered`` object
+    best-effort (the bench's graphcheck provenance hook — dtype audit
+    from the very lowering being timed, without a second trace)."""
     try:
         lowered = jitted_fn.lower(*args, **kwargs)
     except Exception:
         return None, jitted_fn
+    if on_lowered is not None:
+        try:
+            on_lowered(lowered)
+        except Exception:
+            pass  # provenance must never fail the measurement
     try:
         flops = _flops_of(lowered.cost_analysis())
     except Exception:

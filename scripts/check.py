@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Run the static-analysis gates: source lint + lowered-graph passes.
+
+Usage::
+
+    python scripts/check.py --all           # everything (the merge gate)
+    python scripts/check.py --lint          # AST rules only (fast)
+    python scripts/check.py --graph         # graph passes, all targets
+    python scripts/check.py --graph --fast  # skip the expensive targets
+                                            # and the double-lowering
+                                            # recompile check
+    python scripts/check.py --all --json out.json
+
+Exit code 0 iff no violations. See docs/ANALYSIS.md for what each
+pass/rule checks and how to allowlist a finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="perceiver-tpu static analysis (lint + graph passes)")
+    ap.add_argument("--all", action="store_true",
+                    help="lint + graph passes over every target")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the AST lint rules")
+    ap.add_argument("--graph", action="store_true",
+                    help="run the lowered-graph passes")
+    ap.add_argument("--fast", action="store_true",
+                    help="graph passes on the fast targets only, "
+                         "without the double-lowering recompile check")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="lint these files/dirs instead of the default "
+                         "(package + scripts + entry points)")
+    ap.add_argument("--json", default=None,
+                    help="also write the report as JSON")
+    args = ap.parse_args()
+    if not (args.all or args.lint or args.graph):
+        args.all = True
+
+    from perceiver_tpu.analysis import (
+        CANONICAL_TARGETS,
+        FAST_TARGETS,
+        Report,
+        default_lint_paths,
+        lint_paths,
+        run_graph_checks,
+    )
+
+    report = Report()
+    if args.all or args.lint:
+        paths = args.paths or default_lint_paths(_REPO)
+        print(f"[check] linting {len(paths)} root(s) ...",
+              file=sys.stderr)
+        report.merge(lint_paths(paths))
+    if args.all or args.graph:
+        targets = FAST_TARGETS if args.fast else CANONICAL_TARGETS
+        print(f"[check] lowering {len(targets)} canonical target(s) "
+              "(CPU backend; no chip needed) ...", file=sys.stderr)
+        report.merge(run_graph_checks(targets, recompile=not args.fast))
+
+    print(report.format())
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report.to_json(), f, indent=1)
+            f.write("\n")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,9 +4,10 @@ weak #7: perf motion that needs no chip).
 
 Round 3's MFU plan FLOP-weighted the systolic-array K-depth ceiling by
 hand (STATUS.md: 75.8% fwd+bwd for the headline config). This script
-derives the same quantities from the ACTUAL lowered computation: it
-traces the full jitted train step (forward + backward + AdamW, the
-exact step ``bench.py`` times), walks the StableHLO for
+derives the same quantities from the ACTUAL lowered computation via
+``perceiver_tpu.analysis`` (the StableHLO walker this one-off grew
+into — ISSUE 1): it lowers the full jitted train step (forward +
+backward + AdamW, the exact step ``bench.py`` times), walks the
 ``dot_general`` ops, and reports
 
   * per-dot shapes, dtypes, contraction depth K, FLOPs;
@@ -14,6 +15,10 @@ exact step ``bench.py`` times), walks the StableHLO for
     (the 128-deep MXU K-padding model used in round 3);
   * dtype audit: FLOP fraction executed in bf16 vs fp32 (catches
     accidental upcasts on the hot path — policy says bf16 compute).
+
+The same numbers gate merges continuously via ``scripts/check.py``
+(``dtype_policy`` pass); this CLI remains for ad-hoc sweeps over
+non-canonical (batch, channels, loss_impl) points.
 
 Usage: python scripts/hlo_audit.py [--batch 512] [--channels 64]
        [--json OUT.json]
@@ -24,97 +29,32 @@ the StableHLO level; no chip required).
 import argparse
 import json
 import os
-import re
 import sys
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-
-_DOT = re.compile(
-    r"stablehlo\.dot_general.*?"
-    r"contracting_dims = \[([0-9, ]*)\] x \[([0-9, ]*)\].*?"
-    r": \(tensor<([^>]+)>, tensor<([^>]+)>\) -> tensor<([^>]+)>")
-
-
-def _parse_tensor(t: str):
-    *dims, dtype = t.split("x")
-    return [int(d) for d in dims], dtype
 
 
 def audit(batch: int, channels: int, seq_len: int = 512,
           vocab: int = 10003, loss_impl: str = "packed") -> dict:
     import jax
+
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
 
-    from perceiver_tpu.ops.policy import Policy
-    from perceiver_tpu.tasks import MaskedLanguageModelTask
+    from perceiver_tpu.analysis import hlo, make_train_step
+    from perceiver_tpu.analysis.targets import _build_mlm
 
-    task = MaskedLanguageModelTask(
-        vocab_size=vocab, max_seq_len=seq_len, loss_impl=loss_impl,
-        num_latent_channels=channels)
-    model = task.build()
-    policy = Policy.bf16()
-    params = model.init(jax.random.key(0))
-    tx = optax.adamw(1e-3)
-    opt_state = tx.init(params)
-    rng = np.random.default_rng(0)
-    batch_data = {
-        "input_ids": jnp.asarray(
-            rng.integers(3, vocab, (batch, seq_len)), jnp.int32),
-        "pad_mask": jnp.zeros((batch, seq_len), bool),
-    }
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, batch_i, key):
-        def loss_fn(p):
-            loss, _ = task.loss_and_metrics(
-                model, p, batch_i, rng=key, deterministic=False,
-                policy=policy)
-            return loss
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
-    text = train_step.lower(params, opt_state, batch_data,
-                            jax.random.key(1)).as_text()
-
-    dots = []
-    for m in _DOT.finditer(text):
-        lhs_c = [int(x) for x in m.group(1).split(",") if x.strip()]
-        lhs_dims, lhs_dt = _parse_tensor(m.group(3))
-        out_dims, out_dt = _parse_tensor(m.group(5))
-        k = 1
-        for d in lhs_c:
-            k *= lhs_dims[d]
-        out_elems = 1
-        for d in out_dims:
-            out_elems *= d
-        flops = 2.0 * out_elems * k
-        dots.append({"lhs": lhs_dims, "out": out_dims, "k": k,
-                     "dtype": lhs_dt, "flops": flops})
-
-    total = sum(d["flops"] for d in dots) or 1.0
-    ceiling = sum(d["flops"] * min(d["k"], 128) / 128.0
-                  for d in dots) / total
-    bf16 = sum(d["flops"] for d in dots if "bf16" in d["dtype"]) / total
-    top = sorted(dots, key=lambda d: -d["flops"])[:8]
+    task, batch_data = _build_mlm(batch=batch, channels=channels,
+                                  seq_len=seq_len, vocab=vocab,
+                                  loss_impl=loss_impl)
+    step, args = make_train_step(task, batch_data)
+    text = step.lower(*args).as_text()
+    summary = hlo.dot_flop_summary(list(hlo.iter_dots(text)))
     return {
         "config": {"batch": batch, "channels": channels,
                    "seq_len": seq_len, "vocab": vocab,
                    "loss_impl": loss_impl},
-        "n_dot_general": len(dots),
-        "total_dot_tflops_per_step": round(total / 1e12, 3),
-        "flop_weighted_k_ceiling": round(ceiling, 4),
-        "bf16_flop_fraction": round(bf16, 4),
-        "top_dots": [{"lhs": d["lhs"], "out": d["out"], "k": d["k"],
-                      "dtype": d["dtype"],
-                      "flop_share": round(d["flops"] / total, 4)}
-                     for d in top],
+        **summary,
     }
 
 

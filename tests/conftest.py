@@ -66,6 +66,8 @@ _SLOW = {
     "test_ulysses.py::TestUlyssesAttention::test_grad_flows",
     "test_spmd_attention_impls.py::test_full_train_step_under_jit",
     "test_spmd_attention_impls.py::test_matches_einsum_baseline[seqpar-4]",
+    "test_graphcheck.py::test_full_graph_sweep_is_clean",
+    "test_graphcheck.py::test_full_lint_sweep_is_clean",
 }
 
 

@@ -1,0 +1,419 @@
+"""Self-verification of the static-analysis subsystem (ISSUE 1).
+
+Every graph pass must demonstrably FAIL on a seeded violation — a
+gate that cannot catch its target defect is worse than no gate,
+because it certifies trees it never checked. Each pass therefore gets
+a tiny synthetic module that violates it (fp32 dot, host callback,
+un-donated state, drifting compile key), a clean twin, and an
+allowlist round-trip where applicable; the lint rules get seeded
+source snippets. The headline-config regression pins
+``bf16_flop_fraction == 1.0`` on the exact B=512/C=64 step bench.py
+times, and the slow full sweep runs what ``scripts/check.py --all``
+gates at merge.
+"""
+
+import itertools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from perceiver_tpu.analysis import (
+    CANONICAL_TARGETS,
+    DtypeAllow,
+    StepTarget,
+    TransferAllow,
+    donation_check,
+    dtype_policy,
+    hlo,
+    lint_source,
+    lower_target,
+    recompile_budget,
+    run_graph_checks,
+    transfer_guard,
+)
+
+
+def _lower_text(fn, *args):
+    return fn.lower(*args).as_text()
+
+
+# --- dtype_policy -----------------------------------------------------------
+
+
+def _fp32_dot_text():
+    @jax.jit
+    def f(a, b):
+        return a @ b
+
+    x = jnp.ones((16, 32), jnp.float32)
+    return _lower_text(f, x, x.T)
+
+
+def test_dtype_policy_fails_on_fp32_dot():
+    violations, summary = dtype_policy(_fp32_dot_text(), where="seeded")
+    assert violations, "fp32 dot_general must violate dtype_policy"
+    assert "f32" in violations[0].message
+    assert summary["bf16_flop_fraction"] == 0.0
+
+
+def test_dtype_policy_passes_bf16_dot():
+    @jax.jit
+    def f(a, b):
+        return a @ b
+
+    x = jnp.ones((16, 32), jnp.bfloat16)
+    violations, summary = dtype_policy(_lower_text(f, x, x.T),
+                                       where="clean",
+                                       require_full_bf16=True)
+    assert not violations
+    assert summary["bf16_flop_fraction"] == 1.0
+
+
+def test_dtype_policy_allowlist_consumes_budget():
+    allow = (DtypeAllow(dtype="f32", max_count=1,
+                        reason="seeded test exception"),)
+    violations, _ = dtype_policy(_fp32_dot_text(), where="seeded",
+                                 allowlist=allow)
+    assert not violations
+    # budget of 1 cannot cover two fp32 dots
+    @jax.jit
+    def g(a, b):
+        return (a @ b) @ (a @ b).T
+
+    x = jnp.ones((8, 8), jnp.float32)
+    violations, _ = dtype_policy(_lower_text(g, x, x), where="seeded",
+                                 allowlist=allow)
+    assert violations
+
+
+def test_dtype_policy_headline_requirement():
+    violations, _ = dtype_policy(
+        _fp32_dot_text(), where="seeded",
+        allowlist=(DtypeAllow(dtype="f32", max_count=8,
+                              reason="mask the per-dot findings"),),
+        require_full_bf16=True)
+    assert any("bf16_flop_fraction" in v.message for v in violations)
+
+
+# --- transfer_guard ---------------------------------------------------------
+
+
+def _callback_text():
+    @jax.jit
+    def f(x):
+        jax.debug.print("x sum {s}", s=x.sum())
+        return x * 2
+
+    return _lower_text(f, jnp.ones((4,)))
+
+
+def test_transfer_guard_fails_on_host_callback():
+    violations = transfer_guard(_callback_text(), where="seeded")
+    assert violations
+    assert "callback" in violations[0].message
+
+
+def test_transfer_guard_allowlist():
+    text = _callback_text()
+    markers = hlo.count_host_markers(text)
+    assert markers, "seeded callback must be visible to the walker"
+    allow = tuple(TransferAllow(marker=m, max_count=n,
+                                reason="seeded test exception")
+                  for m, n in markers.items())
+    assert not transfer_guard(text, where="seeded", allowlist=allow)
+
+
+def test_transfer_guard_passes_clean_module():
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    assert not transfer_guard(_lower_text(f, jnp.ones((4,))),
+                              where="clean")
+
+
+# --- donation_check ---------------------------------------------------------
+
+
+def _state_step(donate):
+    dec = (partial(jax.jit, donate_argnums=(0,)) if donate else jax.jit)
+
+    @dec
+    def step(state, batch):
+        new = jax.tree.map(lambda s: s + batch.sum(), state)
+        return new
+
+    state = {"w": jnp.ones((8, 8)), "b": jnp.ones((8,))}
+    return _lower_text(step, state, jnp.ones((4,)))
+
+
+def test_donation_check_fails_on_undonated_state():
+    violations = donation_check(_state_step(donate=False),
+                                where="seeded", expected_donated=2)
+    assert violations
+    assert "0/2" in violations[0].message
+
+
+def test_donation_check_passes_donated_state():
+    assert not donation_check(_state_step(donate=True), where="clean",
+                              expected_donated=2)
+
+
+@pytest.mark.filterwarnings("ignore:Some donated buffers were not usable")
+def test_donation_check_fails_on_shape_drifted_state():
+    # donated but unaliasable: the output state shape differs from the
+    # input, so lowering cannot alias — exactly what forgetting to
+    # keep state shapes stable across the step looks like
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state):
+        return {"w": state["w"][:4]}
+
+    text = _lower_text(step, {"w": jnp.ones((8, 8))})
+    assert donation_check(text, where="seeded", expected_donated=1)
+
+
+# --- recompile_budget -------------------------------------------------------
+
+
+def _tiny_mlm():
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    return MaskedLanguageModelTask(
+        vocab_size=110, max_seq_len=16, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+
+
+def _tiny_batch(batch=2, seq=16, vocab=110):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    return {
+        "input_ids": jnp.asarray(
+            rng.integers(3, vocab, (batch, seq)), jnp.int32),
+        "pad_mask": jnp.zeros((batch, seq), bool),
+    }
+
+
+def test_recompile_budget_passes_stable_target():
+    target = StepTarget(name="tiny_stable",
+                        build=lambda: (_tiny_mlm(), _tiny_batch()))
+    violations, fp = recompile_budget(target)
+    assert not violations
+    assert fp
+
+
+def test_recompile_budget_fails_on_drifting_shapes():
+    counter = itertools.count(2)
+    target = StepTarget(
+        name="tiny_drift",
+        build=lambda: (_tiny_mlm(), _tiny_batch(batch=next(counter))))
+    violations, _ = recompile_budget(target)
+    assert any("different step signatures" in v.message
+               for v in violations)
+
+
+# --- lint rules -------------------------------------------------------------
+
+
+_JIT_ITEM = """
+import jax
+
+@jax.jit
+def f(x):
+    return x.sum().item()
+"""
+
+_JIT_FLOAT = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=(1,))
+def f(x, n):
+    return float(x) + n
+"""
+
+_JIT_NUMPY = """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    return np.asarray(x) * 2
+"""
+
+_JIT_TIME_RNG = """
+import jax
+import time
+import numpy as np
+
+@jax.jit
+def f(x):
+    t = time.time()
+    return x * np.random.normal() + t
+"""
+
+_JIT_CALL_FORM = """
+import jax
+
+def step(state):
+    return state.item()
+
+run = jax.jit(step, donate_argnums=0)
+"""
+
+_HOST_SIDE_CLEAN = """
+import time
+import numpy as np
+
+def host_loop(x):
+    t = time.time()
+    return float(np.asarray(x).sum()) + t
+"""
+
+_SHAPE_ACCESS_CLEAN = """
+import jax
+
+@jax.jit
+def f(x):
+    return x * int(x.shape[0])
+"""
+
+
+def _checks(src, path="<memory>"):
+    return [v.check for v in lint_source(src, path)]
+
+
+def test_lint_flags_item_in_jit():
+    assert "jit-host-sync" in _checks(_JIT_ITEM)
+
+
+def test_lint_flags_float_of_traced_param():
+    assert "jit-host-sync" in _checks(_JIT_FLOAT)
+
+
+def test_lint_flags_numpy_in_jit():
+    assert "jit-host-sync" in _checks(_JIT_NUMPY)
+
+
+def test_lint_flags_time_and_np_random_in_jit():
+    checks = _checks(_JIT_TIME_RNG)
+    assert checks.count("jit-python-rng-time") == 2
+
+
+def test_lint_follows_jit_call_form():
+    # jax.jit(fn, ...) marks fn traced even without a decorator
+    assert "jit-host-sync" in _checks(_JIT_CALL_FORM)
+
+
+def test_lint_ignores_host_side_code():
+    assert not _checks(_HOST_SIDE_CLEAN)
+
+
+def test_lint_allows_static_shape_access():
+    assert not _checks(_SHAPE_ACCESS_CLEAN)
+
+
+def test_lint_ops_numpy_mix_scoped_to_ops():
+    src = "import numpy as np\nimport jax.numpy as jnp\n"
+    assert "ops-numpy-mix" in _checks(src, "perceiver_tpu/ops/new.py")
+    assert not _checks(src, "perceiver_tpu/data/new.py")
+    np_only = "import numpy as np\n"
+    assert not _checks(np_only, "perceiver_tpu/ops/fourier2.py")
+
+
+_IMPL_UNVALIDATED = """
+import dataclasses
+from typing import Optional
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    dropout: float = 0.0
+    attention_impl: Optional[str] = None
+
+    def __post_init__(self):
+        # the reverted tasks/base.py shape: a feature guard using a
+        # positive membership test, but no domain validation
+        if self.dropout > 0.0 and self.attention_impl in ("flash",):
+            raise ValueError("no dropout for flash")
+"""
+
+def test_lint_catches_missing_impl_validation():
+    # the exact pre-fix tasks/base.py shape (ADVICE r5): feature guard
+    # present, domain validation absent — must be flagged
+    assert "impl-field-validation" in _checks(_IMPL_UNVALIDATED)
+
+
+def test_lint_accepts_not_in_domain_validation():
+    src = _IMPL_UNVALIDATED.replace(
+        'raise ValueError("no dropout for flash")',
+        'raise ValueError("no dropout for flash")\n'
+        '        if self.attention_impl not in (None, "einsum"):\n'
+        '            raise ValueError("bad impl")')
+    assert "impl-field-validation" not in _checks(src)
+
+
+def test_lint_suppression_marker():
+    src = _JIT_ITEM.replace(".item()", ".item()  # graphcheck: ignore")
+    assert not _checks(src)
+
+
+def test_lint_clean_on_fixed_tree_files():
+    # the files this PR fixed must stay clean under the rules that
+    # flagged them (regression for the ADVICE r5 finding)
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in ("perceiver_tpu/tasks/base.py",
+                "perceiver_tpu/models/perceiver.py"):
+        with open(os.path.join(root, rel)) as f:
+            assert not lint_source(f.read(), rel), rel
+
+
+# --- headline regression + full sweep ---------------------------------------
+
+
+def test_headline_config_bf16_flop_fraction_is_one():
+    """B=512/C=64 packed MLM (bench.py _LADDER[0]): every dot FLOP in
+    the lowered train step runs on bf16 operands — the round-4 audit's
+    9.1%-at-fp32 regression, pinned forever."""
+    target = CANONICAL_TARGETS[0]
+    assert target.name == "mlm_b512_c64_packed" and target.headline
+    lowered = lower_target(target)
+    summary = hlo.dot_flop_summary(list(hlo.iter_dots(lowered.text)))
+    assert summary["bf16_flop_fraction"] == 1.0
+    violations, _ = dtype_policy(lowered.text, where=target.name,
+                                 require_full_bf16=True)
+    assert not violations
+    # and its donation + transfer contracts hold
+    assert not donation_check(lowered.text, where=target.name,
+                              expected_donated=lowered.expected_donated)
+    assert not transfer_guard(lowered.text, where=target.name,
+                              allowlist=target.transfer_allow)
+
+
+def test_full_graph_sweep_is_clean():
+    """What ``scripts/check.py --graph`` gates at merge: every
+    canonical target, all four passes including the double-lowering
+    recompile check. Slow-marked (see conftest)."""
+    report = run_graph_checks(CANONICAL_TARGETS, recompile=True)
+    assert report.ok, report.format()
+    assert set(report.checks_run) == {"dtype_policy", "transfer_guard",
+                                      "donation_check",
+                                      "recompile_budget"}
+
+
+def test_full_lint_sweep_is_clean():
+    """What ``scripts/check.py --lint`` gates at merge. Slow-marked."""
+    import os
+
+    from perceiver_tpu.analysis import default_lint_paths, lint_paths
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    report = lint_paths(default_lint_paths(root))
+    assert report.ok, report.format()
