@@ -71,17 +71,25 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  ".jax_cache"))  # same dir the watcher exports
 
-# (batch_size, inner_steps, loss_impl), most → least aggressive.
-# MFU analysis (C=64 contracts the MXU's 128-deep K dim at 50%, so the
-# ~40% target needs ~80% relative efficiency): the FLOP majority is
-# the packed vocab matmul, whose efficiency grows with rows — push
-# batch as high as HBM allows before degrading.
+# Rung dicts, most → least aggressive. The top rung IS the round-5
+# on-chip winner (logs/perf_matrix_r05.jsonl: pallas streaming CE +
+# chunked encoder/decoder attention + remat at B512/inner16 →
+# 3.29M tokens/s/chip) so `python bench.py` with no env vars measures
+# the winning config — the driver never sets knobs (VERDICT r5 item 2).
+# The C=128 rung exists because C=64 is bandwidth-capped at ~0.12 MFU
+# by physics (docs/BENCHMARKING.md): the ≥40% MFU north star can only
+# be measured at C≥128 (graph ceiling 91.9%, VERDICT r5 item 1).
+# Packed/einsum rungs stay as the A/B comparison + degrade ladder.
 _LADDER = [
-    (512, 8, "packed"),
-    (256, 8, "packed"),
-    (128, 4, "packed"),
-    (64, 1, "packed"),
-    (64, 1, "dense"),
+    dict(batch=512, inner=16, loss="pallas", attn="chunked",
+         dec="chunked", remat=True),
+    dict(batch=512, inner=16, loss="pallas", attn="chunked",
+         dec="chunked", remat=True, channels=128),
+    dict(batch=512, inner=8, loss="packed"),
+    dict(batch=256, inner=8, loss="packed"),
+    dict(batch=128, inner=4, loss="packed"),
+    dict(batch=64, inner=1, loss="packed"),
+    dict(batch=64, inner=1, loss="dense"),
 ]
 
 # Default probe-retry budget, seconds. MUST stay inside the driver's
@@ -236,6 +244,14 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
         if os.environ.get("BENCH_GRAPHCHECK", "1") == "0":
             return
         try:
+            # cost-analysis bytes of the very lowering being timed —
+            # the same number the hbm_budget merge gate pins
+            # (perceiver_tpu/analysis/hbm_budgets.json), riding the
+            # result so every row carries its traffic provenance
+            from perceiver_tpu.analysis.targets import (
+                cost_bytes_accessed,
+            )
+            graphcheck["hbm_bytes"] = cost_bytes_accessed(lowered)
             from perceiver_tpu.analysis import hlo
             s = hlo.dot_flop_summary(list(hlo.iter_dots(
                 lowered.as_text())))
@@ -339,6 +355,9 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
             # numbers were actually measured on, machine-readable
             "platform": jax.devices()[0].platform,
             "device_kind": getattr(jax.devices()[0], "device_kind", None),
+            # cost-analysis bytes/step of the timed lowering (the
+            # hbm_budget gate's metric; None off cost-model backends)
+            "hbm_bytes": graphcheck.pop("hbm_bytes", None),
             # lowered-graph dtype provenance (scripts/check.py gates
             # the same numbers at merge; here they ride the result)
             "graphcheck": graphcheck or None,
@@ -346,32 +365,43 @@ def _bench_train(task, stacked_batch: dict, *, batch_size: int,
     }
 
 
-def _env_knobs() -> dict:
-    """Perf knobs (trace-driven, r05): the b256 trace showed the step
-    HBM-bound at ~38 GB accessed/step — the levers that cut traffic
+def _knobs(rung: dict) -> dict:
+    """Perf knobs (trace-driven, r05): the levers that cut HBM traffic
     are the streaming CE (loss_impl=pallas, MLM only),
-    non-materializing attention (BENCH_ATTN_IMPL=chunked|flash),
-    decoder ditto (BENCH_DEC_IMPL), and remat (BENCH_REMAT=1:
-    recompute instead of storing scan residuals — FLOPs are nearly
-    free at this MFU). Shared TaskConfig fields, so every BENCH_TASK
-    honors them; the values are echoed into the result detail dict so
-    rows from different knob combinations stay distinguishable."""
+    non-materializing attention (attn=chunked|flash), decoder ditto
+    (dec), and remat (recompute instead of storing scan residuals —
+    FLOPs are nearly free at this MFU). The RUNG supplies the defaults
+    (the ladder's top rung carries the round-5 winner combination);
+    BENCH_ATTN_IMPL / BENCH_DEC_IMPL / BENCH_KV_CHUNK / BENCH_REMAT
+    override them exactly — sweeps rely on that. Shared TaskConfig
+    fields, so every BENCH_TASK honors them; the values are echoed
+    into the result detail dict so rows from different knob
+    combinations stay distinguishable."""
+    remat_env = os.environ.get("BENCH_REMAT")
     return dict(
-        attention_impl=os.environ.get("BENCH_ATTN_IMPL") or None,
-        decoder_attention_impl=os.environ.get("BENCH_DEC_IMPL") or None,
+        attention_impl=(os.environ.get("BENCH_ATTN_IMPL")
+                        or rung.get("attn")),
+        decoder_attention_impl=(os.environ.get("BENCH_DEC_IMPL")
+                                or rung.get("dec")),
         kv_chunk_size=int(os.environ.get("BENCH_KV_CHUNK", "1024")),
-        remat=os.environ.get("BENCH_REMAT", "0") == "1")
+        remat=(remat_env == "1" if remat_env is not None
+               else bool(rung.get("remat", False))))
 
 
-def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
+def run(rung: dict) -> dict:
     import jax.numpy as jnp
 
     from perceiver_tpu.tasks import MaskedLanguageModelTask
 
+    batch_size, inner_steps = rung["batch"], rung["inner"]
+    loss_impl = rung["loss"]
     seq_len, vocab = 512, 10003
+    channels = int(os.environ.get("BENCH_CHANNELS",
+                                  str(rung.get("channels", 64))))
+    knobs = _knobs(rung)
     task = MaskedLanguageModelTask(
         vocab_size=vocab, max_seq_len=seq_len, loss_impl=loss_impl,
-        **_env_knobs())
+        num_latent_channels=channels, **knobs)
     rng = np.random.default_rng(0)
     stacked = {
         "input_ids": jnp.asarray(rng.integers(
@@ -383,10 +413,10 @@ def run(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
         units_per_step=batch_size * seq_len,
         metric="imdb_mlm_tokens_per_sec_per_chip", unit="tokens/s",
         detail={"seq_len": seq_len, "loss_impl": loss_impl,
-                **_env_knobs()})
+                "num_latent_channels": channels, **knobs})
 
 
-def run_img(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
+def run_img(rung: dict) -> dict:
     """Secondary BASELINE.md metric: MNIST imgs/sec/chip with the
     ``scripts/img_clf.py`` model config (32×128 latents, 3 layers,
     3 self-attn layers/block, 32 frequency bands)."""
@@ -394,12 +424,13 @@ def run_img(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
 
     from perceiver_tpu.tasks import ImageClassifierTask
 
-    del loss_impl  # CE over 10 classes; no fused-loss variants
+    batch_size, inner_steps = rung["batch"], rung["inner"]
+    knobs = _knobs(rung)  # CE over 10 classes; no fused-loss variants
     task = ImageClassifierTask(
         image_shape=(28, 28, 1), num_classes=10, num_frequency_bands=32,
         num_latents=32, num_latent_channels=128, num_encoder_layers=3,
         num_encoder_self_attention_layers_per_block=3,
-        num_decoder_cross_attention_heads=1, **_env_knobs())
+        num_decoder_cross_attention_heads=1, **knobs)
     rng = np.random.default_rng(0)
     stacked = {
         "image": jnp.asarray(rng.normal(
@@ -411,10 +442,10 @@ def run_img(batch_size: int, inner_steps: int, loss_impl: str) -> dict:
         task, stacked, batch_size=batch_size, inner_steps=inner_steps,
         units_per_step=batch_size,
         metric="mnist_imgs_per_sec_per_chip", unit="imgs/s",
-        detail={"image_shape": [28, 28, 1], **_env_knobs()})
+        detail={"image_shape": [28, 28, 1], **knobs})
 
 
-def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
+def run_seg(rung: dict):
     """``BENCH_TASK=seg``: the 512×512 / 262,144-output-query LArTPC
     segmentation config (``run.py:72-112``) — pixels/sec/chip, the
     decoder-query-chunking + long-kv memory stress config.
@@ -424,11 +455,12 @@ def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
 
     from perceiver_tpu.tasks import SegmentationTask
 
-    del loss_impl  # weighted CE over 3 classes; no fused variants
+    batch_size, inner_steps = rung["batch"], rung["inner"]
+    knobs = _knobs(rung)  # weighted CE over 3 classes; no fused variants
     side = int(os.environ.get("BENCH_SEG_SIZE", "512"))
     task = SegmentationTask(image_shape=(side, side, 1),
                             query_chunk_size=min(16384, side * side),
-                            **_env_knobs())
+                            **knobs)
     rng = np.random.default_rng(0)
     stacked = {
         "image": jnp.asarray(
@@ -443,7 +475,7 @@ def run_seg(batch_size: int, inner_steps: int, loss_impl: str):
         units_per_step=batch_size * side * side,
         metric="lartpc_seg_pixels_per_sec_per_chip", unit="pixels/s",
         detail={"image_shape": [side, side, 1],
-                "num_output_queries": side * side, **_env_knobs()})
+                "num_output_queries": side * side, **knobs})
 
 
 # Probe run in a SUBPROCESS: a half-dead tunnel blocks block_until_ready
@@ -703,39 +735,47 @@ def main():
 
     pinned = any(k in os.environ for k in
                  ("BENCH_BATCH", "BENCH_INNER_STEPS", "BENCH_LOSS_IMPL"))
-    top_b, top_inner, top_impl = _LADDER[0]
+    top = _LADDER[0]
     if pinned:
-        configs = [(int(os.environ.get("BENCH_BATCH", str(top_b))),
-                    int(os.environ.get("BENCH_INNER_STEPS",
-                                       str(top_inner))),
-                    os.environ.get("BENCH_LOSS_IMPL", top_impl))]
+        # a pinned config carries NO rung knob defaults — exactly the
+        # env vars the sweep set (BENCH_ATTN_IMPL etc.), nothing more,
+        # so historical sweep rows stay comparable
+        configs = [dict(
+            batch=int(os.environ.get("BENCH_BATCH", str(top["batch"]))),
+            inner=int(os.environ.get("BENCH_INNER_STEPS",
+                                     str(top["inner"]))),
+            loss=os.environ.get("BENCH_LOSS_IMPL", top["loss"]))]
     else:
         # SMALLEST config first (driver contract, module docstring):
         # each completed rung flushes its JSON line immediately, so a
         # kill or tunnel death mid-climb still leaves every number
         # collected so far on stdout; climbing stops at the first
         # failed rung after a success (an OOM at batch B repeats at
-        # batch 2B). The default (packed) impl climbs first — fastest
-        # route to a number; the dense rung runs last as the
-        # packed-impl-broke fallback and the on-chip impl comparison.
+        # batch 2B). The primary track (packed ladder up to the pallas
+        # winner rungs) climbs first — fastest route to a number; the
+        # dense rung runs last as the fallback when the fused impls
+        # break for an impl-specific reason, and the impl comparison.
         rungs = list(reversed(_LADDER))
-        configs = ([c for c in rungs if c[2] == "packed"]
-                   + [c for c in rungs if c[2] != "packed"])
+        configs = ([c for c in rungs if c["loss"] != "dense"]
+                   + [c for c in rungs if c["loss"] == "dense"])
 
     runner = {"img_clf": run_img, "seg": run_seg}.get(
         os.environ.get("BENCH_TASK", ""), run)
     if runner is run_seg and not pinned:
         # the 262k-query config is memory-bound in BATCH, not in
         # inner_steps — its ladder climbs the axis that matters
-        configs = [(1, 1, "n/a"), (2, 1, "n/a"), (4, 1, "n/a")]
+        configs = [dict(batch=1, inner=1, loss="n/a"),
+                   dict(batch=2, inner=1, loss="n/a"),
+                   dict(batch=4, inner=1, loss="n/a")]
     elif runner is not run:
-        # loss_impl doesn't apply to these tasks — collapse ladder
-        # entries that only differ in it (keep first-seen order)
+        # loss_impl/channels don't apply to these tasks — collapse
+        # ladder entries that only differ in them (keep first-seen
+        # order and the first-seen rung's attention/remat knobs)
         seen, deduped = set(), []
-        for b, inner, _ in configs:
-            if (b, inner) not in seen:
-                seen.add((b, inner))
-                deduped.append((b, inner, "n/a"))
+        for c in configs:
+            if (c["batch"], c["inner"]) not in seen:
+                seen.add((c["batch"], c["inner"]))
+                deduped.append(dict(c, loss="n/a"))
         configs = deduped
 
     try:
@@ -749,7 +789,8 @@ def main():
     results, last_err = [], None
     batch_cap = None  # set by the first failure after a success
     max_ok_batch = 0
-    for i, (b, inner, impl) in enumerate(configs):
+    for i, rung in enumerate(configs):
+        b, inner, impl = rung["batch"], rung["inner"], rung["loss"]
         if batch_cap is not None and b > batch_cap:
             # an OOM at batch B repeats at every larger rung — but
             # smaller later rungs (the dense comparison at the
@@ -758,9 +799,12 @@ def main():
                  f"a failed rung)")
             continue
         _log(f"config {i + 1}/{len(configs)}: "
-             f"batch={b} inner={inner} loss={impl}")
+             f"batch={b} inner={inner} loss={impl} "
+             f"attn={rung.get('attn')} dec={rung.get('dec')} "
+             f"remat={bool(rung.get('remat'))} "
+             f"C={rung.get('channels', 64)}")
         try:
-            result = runner(b, inner, impl)
+            result = runner(rung)
             _log("done")
             # flush NOW: a kill mid-climb must not lose this rung
             print(json.dumps(result), flush=True)

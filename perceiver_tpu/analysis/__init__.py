@@ -22,14 +22,18 @@ from perceiver_tpu.analysis.report import (  # noqa: F401
 from perceiver_tpu.analysis.passes import (  # noqa: F401
     donation_check,
     dtype_policy,
+    hbm_budget,
+    load_hbm_budgets,
     recompile_budget,
     run_graph_checks,
     transfer_guard,
+    write_hbm_budgets,
 )
 from perceiver_tpu.analysis.targets import (  # noqa: F401
     CANONICAL_TARGETS,
     FAST_TARGETS,
     StepTarget,
+    cost_bytes_accessed,
     lower_target,
     make_train_step,
 )

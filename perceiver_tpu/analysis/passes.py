@@ -11,7 +11,9 @@ the lowered module, so they are checked on the lowered module.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from perceiver_tpu.analysis import hlo
 from perceiver_tpu.analysis.report import (
@@ -145,14 +147,109 @@ def recompile_budget(target: StepTarget,
     return violations, fp1
 
 
+# --- hbm_budget --------------------------------------------------------------
+# Checked-in per-target byte budgets. The round-6 traffic work cut the
+# headline step's cost-analysis bytes 38% — this pass is what keeps
+# that win from silently eroding: any step whose lowered module
+# accesses more bytes than its pinned budget fails the merge gate.
+
+_HBM_MANIFEST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "hbm_budgets.json")
+# budget = pinned_bytes · headroom: room for benign refactors and
+# jax-version drift in the cost model, small enough that a real
+# regression (a re-materialized residual, an fp32 copy) still trips
+_HBM_HEADROOM = 1.05
+
+
+def load_hbm_budgets(path: Optional[str] = None) -> Dict[str, dict]:
+    """Target-name → ``{budget_bytes, pinned_bytes, pinned}`` from the
+    checked-in manifest (empty dict when the manifest is absent — every
+    canonical target then fails with a missing-budget violation, so a
+    deleted manifest cannot read as a clean tree)."""
+    try:
+        with open(path or _HBM_MANIFEST) as f:
+            return json.load(f)["targets"]
+    except (OSError, KeyError, ValueError):
+        return {}
+
+
+def write_hbm_budgets(measured: Dict[str, float],
+                      path: Optional[str] = None,
+                      headroom: float = _HBM_HEADROOM,
+                      note: str = "") -> dict:
+    """Re-baseline: pin each target's measured bytes and derive its
+    budget. Only for INTENTIONAL traffic changes — see docs/ANALYSIS.md
+    for the re-baseline protocol (the diff of this file is the audit
+    trail of every accepted regression or win)."""
+    manifest = {
+        "_comment": (
+            "hbm_budget manifest — XLA cost-analysis 'bytes accessed' "
+            "per canonical train step (CPU lowering, scan bodies "
+            "counted once). budget_bytes = pinned_bytes x "
+            f"{headroom}. Re-baseline via scripts/check.py "
+            "--rebaseline-hbm after an intentional change; never edit "
+            "budgets by hand to make a regression pass."),
+        "targets": {
+            name: {
+                "budget_bytes": int(value * headroom),
+                "pinned_bytes": int(value),
+                "pinned": note,
+            }
+            for name, value in sorted(measured.items())
+        },
+    }
+    with open(path or _HBM_MANIFEST, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.write("\n")
+    return manifest
+
+
+def hbm_budget(bytes_accessed: Optional[float], *, where: str,
+               budgets: Dict[str, dict]) -> List[Violation]:
+    """The lowered step's cost-analysis bytes must stay within the
+    target's pinned budget. A missing budget is itself a violation —
+    every canonical target must be budgeted, or adding a target would
+    silently opt it out of the traffic gate."""
+    entry = budgets.get(where)
+    if entry is None:
+        return [Violation(
+            check="hbm_budget", where=where,
+            message="no byte budget pinned for this target in "
+                    "hbm_budgets.json — run scripts/check.py "
+                    "--rebaseline-hbm and commit the manifest")]
+    if bytes_accessed is None:
+        return [Violation(
+            check="hbm_budget", where=where,
+            message="lowering exposed no cost analysis, so the byte "
+                    "budget cannot be checked — run the gate on a "
+                    "backend with lowering-time cost analysis (CPU)")]
+    budget = float(entry["budget_bytes"])
+    if bytes_accessed > budget:
+        pinned = float(entry.get("pinned_bytes", budget))
+        return [Violation(
+            check="hbm_budget", where=where,
+            message=f"bytes accessed {bytes_accessed / 1e9:.2f} GB "
+                    f"exceeds the pinned budget {budget / 1e9:.2f} GB "
+                    f"({100 * (bytes_accessed / pinned - 1):+.1f}% vs "
+                    "the pinned baseline) — the step's HBM traffic "
+                    "regressed; fix the graph or, for an intentional "
+                    "change, re-baseline via scripts/check.py "
+                    "--rebaseline-hbm and justify it in the PR")]
+    return []
+
+
 def run_graph_checks(targets: Sequence[StepTarget] = CANONICAL_TARGETS,
                      *, recompile: bool = True) -> Report:
     """Lower each target and run all graph passes. ``recompile=False``
     skips the second lowering per target (the fast tier-1 subset)."""
     report = Report()
     fingerprints = {}
+    budgets = load_hbm_budgets()
     for target in targets:
         lowered = lower_target(target)
+        report.extend(hbm_budget(lowered.bytes_accessed,
+                                 where=target.name, budgets=budgets))
+        report.ran("hbm_budget")
         vs, _summary = dtype_policy(
             lowered.text, where=target.name,
             allowlist=target.dtype_allow,

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 from perceiver_tpu.analysis.report import DtypeAllow, TransferAllow
 
@@ -65,6 +65,26 @@ class LoweredStep:
     # aliased onto an output by lowering
     expected_donated: int
     task_hash: int
+    # XLA HLO-cost-analysis "bytes accessed" of the lowered module
+    # (scan/while bodies counted once) — the hbm_budget pass's metric.
+    # None when the backend exposes no lowering-time cost analysis.
+    bytes_accessed: Optional[float] = None
+
+
+def cost_bytes_accessed(lowered) -> Optional[float]:
+    """``bytes accessed`` from a ``jax.stages.Lowered`` cost analysis,
+    or None where unavailable (e.g. the axon TPU plugin, which only
+    exposes post-compile analysis)."""
+    try:
+        cost = lowered.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not cost:
+        return None
+    value = cost.get("bytes accessed")
+    return float(value) if value is not None else None
 
 
 def make_train_step(task, batch):
@@ -107,9 +127,10 @@ def lower_target(target: StepTarget) -> LoweredStep:
     step, args = make_train_step(task, batch)
     params, opt_state = args[0], args[1]
     expected = len(jax.tree_util.tree_leaves((params, opt_state)))
-    text = step.lower(*args).as_text()
-    return LoweredStep(target=target, text=text,
-                       expected_donated=expected, task_hash=hash(task))
+    lowered = step.lower(*args)
+    return LoweredStep(target=target, text=lowered.as_text(),
+                       expected_donated=expected, task_hash=hash(task),
+                       bytes_accessed=cost_bytes_accessed(lowered))
 
 
 # --------------------------------------------------------------------------

@@ -48,6 +48,7 @@ from perceiver_tpu.ops.attention import (
     DECODER_ATTENTION_IMPLS,
     cross_attention_init,
     cross_attention_apply,
+    cross_attention_kv,
     self_attention_init,
     self_attention_apply,
 )
@@ -88,14 +89,21 @@ def cross_attention_layer_apply(params, x_q, x_kv, *, num_heads,
                                 dropout_rate=0.0, rng=None,
                                 deterministic=True,
                                 policy: Policy = DEFAULT_POLICY,
-                                impl=None, kv_chunk_size=1024, spmd=None):
-    """Residual(CrossAttention) then Residual(mlp) (model.py:29-33)."""
+                                impl=None, kv_chunk_size=1024, spmd=None,
+                                kv_heads=None):
+    """Residual(CrossAttention) then Residual(mlp) (model.py:29-33).
+
+    ``kv_heads`` carries the pre-normed, pre-projected kv from
+    ``cross_attention_kv`` — the encoder hoists it out of the layer
+    scan because the kv tokens (and the shared layer weights) are
+    loop-invariant there."""
     k_attn, k_r1, k_r2 = jax.random.split(_rng_or_dummy(rng, deterministic), 3)
     y = cross_attention_apply(
         params["attn"], x_q, x_kv, num_heads=num_heads,
         key_padding_mask=key_padding_mask, attn_mask=attn_mask,
         dropout_rate=dropout_rate, rng=k_attn, deterministic=deterministic,
-        policy=policy, impl=impl, kv_chunk_size=kv_chunk_size, spmd=spmd)
+        policy=policy, impl=impl, kv_chunk_size=kv_chunk_size, spmd=spmd,
+        kv_heads=kv_heads)
     x = x_q + dropout(y, dropout_rate, rng=k_r1, deterministic=deterministic)
     y = mlp_apply(params["mlp"], x, policy=policy)
     return x + dropout(y, dropout_rate, rng=k_r2, deterministic=deterministic)
@@ -219,17 +227,17 @@ class PerceiverEncoder:
             params["layer_n"] = self._layer_init(kn)
         return params
 
-    def _layer_apply(self, params, latent, x, pad_mask, attn_mask, rng,
-                     deterministic, policy):
+    def _layer_apply(self, params, latent, kv_heads, pad_mask, attn_mask,
+                     rng, deterministic, policy):
         k_cross, k_selfs = jax.random.split(_rng_or_dummy(rng))
         latent = cross_attention_layer_apply(
-            params["cross"], latent, x,
+            params["cross"], latent, None,
             num_heads=self.num_cross_attention_heads,
             key_padding_mask=pad_mask, attn_mask=attn_mask,
             dropout_rate=self.dropout, rng=k_cross,
             deterministic=deterministic, policy=policy,
             impl=self.attention_impl, kv_chunk_size=self.kv_chunk_size,
-            spmd=self.spmd)
+            spmd=self.spmd, kv_heads=kv_heads)
         return self_attention_block_apply(
             params["selfs"], latent,
             num_heads=self.num_self_attention_heads,
@@ -248,22 +256,38 @@ class PerceiverEncoder:
 
         k1, kn = jax.random.split(_rng_or_dummy(rng, deterministic))
 
-        def one_layer(layer_params, latent, k):
-            return self._layer_apply(layer_params, latent, x, pad_mask,
-                                     attn_mask, k, deterministic, policy)
+        def layer_kv(layer_params):
+            # hoisted loop-invariant kv: the cross-attention norms and
+            # projects the SAME input tokens with the SAME (shared)
+            # weights in every scan iteration — compute once per
+            # distinct parameter set, close over it in the scan body
+            return cross_attention_kv(
+                layer_params["cross"]["attn"], x,
+                num_heads=self.num_cross_attention_heads, policy=policy)
+
+        def one_layer(layer_params, kv_heads, latent, k):
+            return self._layer_apply(layer_params, latent, kv_heads,
+                                     pad_mask, attn_mask, k,
+                                     deterministic, policy)
 
         if self.remat:
             one_layer = jax.checkpoint(one_layer)
 
-        latent = one_layer(params["layer_1"], latent, k1)
+        latent = one_layer(params["layer_1"], layer_kv(params["layer_1"]),
+                           latent, k1)
         if self.num_layers > 1:
             # Weight-shared recurrence (model.py:186-187): one compiled
             # body, scanned num_layers-1 times over per-iteration keys.
             keys = jax.random.split(kn, self.num_layers - 1)
             layer_n = params["layer_n"]
+            kv_n = layer_kv(layer_n)
 
             def body(carry, k):
-                return one_layer(layer_n, carry, k), None
+                # explicit compute-dtype carry: the latent rides the
+                # scan in bf16 under the default policy (fp32 master
+                # values live only in params/optimizer state)
+                return one_layer(layer_n, kv_n,
+                                 policy.cast_compute(carry), k), None
 
             latent, _ = jax.lax.scan(body, latent, keys)
         return latent, pad_mask
@@ -315,14 +339,23 @@ class PerceiverDecoder:
 
     def apply(self, params, x, pad_mask=None, *, rng=None,
               deterministic: bool = True, policy: Policy = DEFAULT_POLICY,
-              return_hidden: bool = False):
+              return_hidden: bool = False, query_positions=None):
         """``pad_mask`` is accepted for the encoder-tuple contract but —
         matching the reference (model.py:229,236) — not applied in the
         decoder cross-attention (the latent kv has no padding).
 
         ``return_hidden=True`` skips the output adapter and returns the
         pre-projection ``(B, K, C)`` query states — the hook for fused
-        projection+loss kernels (``perceiver_tpu.ops.fused_ce``)."""
+        projection+loss kernels (``perceiver_tpu.ops.fused_ce``).
+
+        ``query_positions`` (B, Q) int32 decodes ONLY those rows of the
+        learned query array (per example). Output queries never attend
+        to each other, so the selected rows are computed exactly as in
+        the full decode — the masked-position-only MLM loss path uses
+        this to shrink every decoder-side tensor from seq_len to the
+        ~mask_p·seq_len positions the loss actually reads. Requires
+        ``return_hidden=True`` (the output adapter's position-wise
+        ``output_shape`` contract assumes the full query array)."""
         del pad_mask
         b, *d = x.shape
         if tuple(d) != tuple(self.latent_shape):
@@ -330,9 +363,16 @@ class PerceiverDecoder:
                 f"Latent shape {tuple(d)} different from required shape "
                 f"{tuple(self.latent_shape)}")
 
-        query = jnp.broadcast_to(
-            policy.cast_param(params["query"])[None],
-            (b, *self.output_adapter.output_shape))
+        if query_positions is not None:
+            if not return_hidden:
+                raise ValueError(
+                    "query_positions requires return_hidden=True")
+            query = jnp.take(policy.cast_param(params["query"]),
+                             query_positions, axis=0)
+        else:
+            query = jnp.broadcast_to(
+                policy.cast_param(params["query"])[None],
+                (b, *self.output_adapter.output_shape))
 
         def run(q, k):
             return cross_attention_layer_apply(
@@ -387,6 +427,37 @@ class PerceiverIO:
             deterministic=deterministic, policy=policy)
 
 
+def _pack_masked_positions(labels, capacity: int):
+    """Left-pack each example's masked positions into (B, capacity).
+
+    labels: (B, L) with ``IGNORE_INDEX`` at unmasked positions (the
+    ``TextMasking`` contract). Returns ``(positions, labels_q,
+    dropped)``: positions (B, capacity) int32 into the L axis (slot j
+    holds the j-th masked position of that row; unused slots point at
+    position 0 with labels_q == IGNORE so downstream weights vanish),
+    labels_q (B, capacity) the labels at those positions, and dropped
+    — the scalar count of masked positions past ``capacity`` (loss
+    bias when nonzero; callers surface it exactly like the packed-CE
+    overflow). The per-row scatter is the batched twin of
+    ``ops.fused_ce.pack_positions``."""
+    from perceiver_tpu.models.masking import IGNORE_INDEX
+
+    b, l = labels.shape
+    sel = labels != IGNORE_INDEX
+    slot = jnp.cumsum(sel.astype(jnp.int32), axis=1) - 1
+    count = slot[:, -1] + 1
+    dropped = jnp.maximum(count - capacity, 0).sum()
+    # unmasked and overflow positions land on a dump slot sliced off
+    slot = jnp.where(sel & (slot < capacity), slot, capacity)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    pos = jnp.broadcast_to(jnp.arange(l, dtype=jnp.int32)[None, :], (b, l))
+    positions = jnp.zeros((b, capacity + 1), jnp.int32)
+    positions = positions.at[rows, slot].set(pos)[:, :capacity]
+    labels_q = jnp.full((b, capacity + 1), IGNORE_INDEX, labels.dtype)
+    labels_q = labels_q.at[rows, slot].set(labels)[:, :capacity]
+    return positions, labels_q, dropped
+
+
 @dataclasses.dataclass(frozen=True)
 class PerceiverMLM:
     """Masked-language model (reference model.py:296-318, plumbing fixed)."""
@@ -402,20 +473,38 @@ class PerceiverMLM:
 
     def apply(self, params, x_input, pad_mask=None, *, masking: bool = True,
               rng=None, deterministic: bool = True,
-              policy: Policy = DEFAULT_POLICY, return_hidden: bool = False):
+              policy: Policy = DEFAULT_POLICY, return_hidden: bool = False,
+              query_capacity: Optional[int] = None):
         """Returns ``(logits, labels)``; ``labels`` is None when
         ``masking=False`` (inference path, reference utils.py:30).
 
         ``return_hidden=True`` returns pre-vocab-projection decoder
         states ``(B, l, C)`` instead of logits (fused-loss hook; the
         vocab projection then happens inside the loss, see
-        ``perceiver_tpu.ops.fused_ce``)."""
+        ``perceiver_tpu.ops.fused_ce``).
+
+        ``query_capacity`` (static int Q, requires masking and
+        return_hidden) switches to the masked-position-only decode:
+        each example's ≤Q masked positions are packed left into a
+        (B, Q) position buffer and ONLY those decoder queries are
+        computed — exact, because output queries never attend to each
+        other, and the loss reads nothing else. Returns
+        ``(hidden (B,Q,C), labels (B,Q) IGNORE-padded, dropped)`` where
+        ``dropped`` counts masked positions past Q (loss bias when
+        nonzero — surface it like the packed-CE overflow). Every
+        decoder-side tensor shrinks seq_len → Q ≈ mask_p·seq_len, the
+        single largest HBM cut on the flagship MLM step."""
         l = x_input.shape[1]
         if masking and rng is None:
             # a silent constant key would mask the same positions in
             # every batch — val_loss would be computed on one fixed,
             # position-correlated 15% subset
             raise ValueError("masking=True requires an explicit `rng` key")
+        if query_capacity is not None and not (masking and return_hidden):
+            raise ValueError(
+                "query_capacity requires masking=True and "
+                "return_hidden=True (it selects masked positions and "
+                "bypasses the output adapter)")
         k_mask, k_enc, k_dec = jax.random.split(
             _rng_or_dummy(rng, deterministic), 3)
 
@@ -427,6 +516,14 @@ class PerceiverMLM:
         latent, _ = self.encoder.apply(
             params["encoder"], x_masked, pad_mask, rng=k_enc,
             deterministic=deterministic, policy=policy)
+        if query_capacity is not None:
+            positions, labels_q, dropped = _pack_masked_positions(
+                labels, query_capacity)
+            hidden = self.decoder.apply(
+                params["decoder"], latent, rng=k_dec,
+                deterministic=deterministic, policy=policy,
+                return_hidden=True, query_positions=positions)
+            return hidden, labels_q, dropped
         out = self.decoder.apply(
             params["decoder"], latent, rng=k_dec,
             deterministic=deterministic, policy=policy,

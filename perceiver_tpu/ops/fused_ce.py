@@ -26,10 +26,11 @@ levers, both exact w.r.t. the dense computation:
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
-from perceiver_tpu.ops.linear import linear_apply
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
 
 
@@ -63,6 +64,68 @@ def pack_positions(hidden, labels, weight, capacity: int):
             overflow)
 
 
+def _project_f32(policy, params, h):
+    """fp32-accumulated vocab projection: one fp32 logits write instead
+    of a compute-dtype write plus an fp32 convert copy (the log-softmax
+    consumer needs fp32 either way)."""
+    w = policy.cast_param(params["w"])
+    b = params["b"].astype(jnp.float32)
+    return jnp.dot(policy.cast_compute(h), w,
+                   preferred_element_type=jnp.float32) + b
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _chunk_nll_sum(policy, params, h, y, w):
+    """``sum(w · nll(linear(h), y))`` for one chunk, via logsumexp.
+
+    The custom VJP is what keeps this memory-bounded: forward reduces
+    the fp32 logits straight to per-row ``(lse, picked-logit)`` without
+    materializing the log-probabilities, and backward recomputes the
+    logits once and emits the compute-dtype softmax-minus-onehot
+    cotangent directly into the two grad contractions. Autodiff of the
+    naive form writes + rereads the fp32 ``(chunk, V)`` log-softmax
+    block three times per step (round-5 trace, vocab-CE bucket).
+    """
+    logits = _project_f32(policy, params, h)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1, keepdims=True))
+    picked = jnp.take_along_axis(logits, jnp.clip(y, 0)[:, None], axis=1)
+    nll = (lse - picked)[:, 0]
+    return (nll * w).sum()
+
+
+def _chunk_nll_fwd(policy, params, h, y, w):
+    return _chunk_nll_sum(policy, params, h, y, w), (params, h, y, w)
+
+
+def _chunk_nll_bwd(policy, res, g):
+    params, h, y, w = res
+    logits = _project_f32(policy, params, h)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1, keepdims=True))
+    picked = jnp.take_along_axis(logits, jnp.clip(y, 0)[:, None], axis=1)
+    # d nll / d logits = softmax - onehot, weighted per row
+    wg = (w * g).astype(jnp.float32)[:, None]
+    onehot = (jnp.arange(logits.shape[-1])[None, :]
+              == jnp.clip(y, 0)[:, None])
+    dlogits = (jnp.exp(logits - lse) - onehot) * wg
+    db = jnp.sum(dlogits, axis=0).astype(params["b"].dtype)
+    # compute-dtype operands for the two big contractions (MXU rate);
+    # the fp32 chain above fuses into this one reduced-precision write
+    dl = dlogits.astype(policy.compute_dtype)
+    hc = policy.cast_compute(h)
+    wc = policy.cast_param(params["w"])
+    dw = jnp.dot(hc.T, dl,
+                 preferred_element_type=jnp.float32).astype(
+                     params["w"].dtype)
+    dh = jnp.dot(dl, wc.T).astype(h.dtype)
+    dwt = ((lse - picked)[:, 0] * g).astype(w.dtype)
+    return {"w": dw, "b": db}, dh, None, dwt
+
+
+_chunk_nll_sum.defvjp(_chunk_nll_fwd, _chunk_nll_bwd)
+
+
 def fused_linear_cross_entropy(linear_params, hidden, labels, weight, *,
                                chunk_size: int = 8192,
                                policy: Policy = DEFAULT_POLICY):
@@ -71,8 +134,9 @@ def fused_linear_cross_entropy(linear_params, hidden, labels, weight, *,
     hidden: (N, C) flattened positions; labels: (N,) int (any value on
     zero-weight rows); weight: (N,) fp32. Numerically identical to
     ``cross_entropy(linear_apply(params, hidden), labels)`` with the
-    same fp32 log-softmax, but peak memory is one ``(chunk, V)`` logits
-    block and the backward pass recomputes logits chunk-by-chunk.
+    same fp32 log-softmax statistics, but peak memory is one
+    ``(chunk, V)`` logits block and the backward pass recomputes
+    logits chunk-by-chunk (``_chunk_nll_sum``).
     Returns scalar ``sum(w·nll) / max(sum(w), 1)``.
     """
     n, c = hidden.shape
@@ -87,16 +151,9 @@ def fused_linear_cross_entropy(linear_params, hidden, labels, weight, *,
     labels = labels.reshape(k, chunk_size)
     weight = weight.reshape(k, chunk_size).astype(jnp.float32)
 
-    @jax.checkpoint
-    def chunk_nll(h, y, w):
-        logits = linear_apply(linear_params, h, policy=policy)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, jnp.clip(y, 0)[:, None], axis=1)[:, 0]
-        return (nll * w).sum()
-
     def body(carry, xs):
         h, y, w = xs
-        return carry + chunk_nll(h, y, w), None
+        return carry + _chunk_nll_sum(policy, linear_params, h, y, w), None
 
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
                             (hidden, labels, weight))

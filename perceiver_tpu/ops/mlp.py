@@ -4,9 +4,19 @@ Reference semantics (``perceiver/model.py:20-26``): LayerNorm →
 Linear(C→H) → GELU → Linear(H→C) where H == C — the reference uses **no
 4× expansion**; hidden width equals channel width. ``widening_factor``
 keeps that default while allowing larger configs.
+
+GELU is the exact (erf) variant the reference's ``nn.GELU()`` uses,
+wrapped in a custom VJP: XLA evaluates ``erf`` on bf16 inputs by
+upcasting to fp32, and autodiff then saves that fp32 upcast as a
+residual — stacked per layer through the encoder's scans, it was one
+of the fp32 activation copies the round-5 trace flagged. The custom
+rule saves only the bf16 input and recomputes the erf/pdf pair in the
+backward pass (one fused elementwise pass).
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -14,6 +24,30 @@ import jax.numpy as jnp
 from perceiver_tpu.ops.linear import linear_init, linear_apply
 from perceiver_tpu.ops.norm import layer_norm_init, layer_norm_apply
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+
+_INV_SQRT2 = 1.0 / math.sqrt(2.0)
+_INV_SQRT_2PI = 1.0 / math.sqrt(2.0 * math.pi)
+
+
+@jax.custom_vjp
+def gelu_exact(x):
+    """x · Φ(x) with Φ the exact normal CDF (erf), fp32 internally."""
+    xf = x.astype(jnp.float32)
+    return (0.5 * xf * (1.0 + jax.lax.erf(xf * _INV_SQRT2))).astype(x.dtype)
+
+
+def _gelu_fwd(x):
+    return gelu_exact(x), x
+
+
+def _gelu_bwd(x, g):
+    xf = x.astype(jnp.float32)
+    cdf = 0.5 * (1.0 + jax.lax.erf(xf * _INV_SQRT2))
+    pdf = _INV_SQRT_2PI * jnp.exp(-0.5 * xf * xf)
+    return ((cdf + xf * pdf) * g.astype(jnp.float32)).astype(x.dtype),
+
+
+gelu_exact.defvjp(_gelu_fwd, _gelu_bwd)
 
 
 def mlp_init(key, dim: int, widening_factor: int = 1, dtype=jnp.float32):
@@ -29,5 +63,5 @@ def mlp_init(key, dim: int, widening_factor: int = 1, dtype=jnp.float32):
 def mlp_apply(params, x, policy: Policy = DEFAULT_POLICY):
     h = layer_norm_apply(params["norm"], x, policy=policy)
     h = linear_apply(params["fc1"], h, policy=policy)
-    h = jax.nn.gelu(h, approximate=False)
+    h = gelu_exact(h)
     return linear_apply(params["fc2"], h, policy=policy)

@@ -80,19 +80,18 @@ class TaskConfig:
                 "and do not apply to output queries)")
         # attention-weight dropout is only implemented for the einsum
         # and chunked kernels (chunked streams it — see
-        # ops/chunked_attention.py)
-        if self.dropout > 0.0 and self.attention_impl in (
-                "flash", "seqpar", "ring", "ulysses"):
-            raise ValueError(
-                f"attention_impl={self.attention_impl!r} does not "
-                f"support attention-weight dropout "
-                f"(dropout={self.dropout}); use attention_impl="
-                "'einsum' or 'chunked', or set --model.dropout=0")
-        if self.dropout > 0.0 and self.decoder_attention_impl == "flash":
-            raise ValueError(
-                "decoder_attention_impl='flash' does not support "
-                f"attention-weight dropout (dropout={self.dropout}); "
-                "use 'einsum' or 'chunked', or set --model.dropout=0")
+        # ops/chunked_attention.py). The other impls DEGRADE to chunked
+        # at trace time with a one-time warning (ops/attention.py
+        # mha_apply), so dropout>0 configs train under every impl
+        # instead of failing — warn here too, where the config is
+        # built, so the degrade is visible before the first trace.
+        if self.dropout > 0.0:
+            from perceiver_tpu.ops.attention import _warn_dropout_degrade
+            if self.attention_impl in ("flash", "seqpar", "ring",
+                                       "ulysses"):
+                _warn_dropout_degrade(self.attention_impl)
+            if self.decoder_attention_impl == "flash":
+                _warn_dropout_degrade(self.decoder_attention_impl)
 
     @property
     def latent_shape(self) -> Tuple[int, int]:

@@ -170,9 +170,29 @@ class MaskedLanguageModelTask(TaskConfig):
             pack_positions,
         )
 
-        hidden, labels = model.apply(
-            params, batch["input_ids"], batch["pad_mask"], rng=rng,
-            deterministic=deterministic, policy=policy, return_hidden=True)
+        packed = self.loss_impl in ("packed", "pallas")
+        l_full = batch["input_ids"].shape[1]
+        dropped = None
+        if packed:
+            # masked-position-only decode: the loss reads nothing but
+            # the ~mask_p·L masked positions, and Perceiver output
+            # queries never attend to each other, so decoding ONLY
+            # those rows is exact — every decoder-side tensor shrinks
+            # seq_len → Q (the flagship step's largest HBM cut).
+            # Q = per-row mean + ~6σ Binomial(L, mask_p) tail, the same
+            # margin the global packed buffer below uses.
+            p = self.mask_p
+            sigma_row = (l_full * p * (1.0 - p)) ** 0.5
+            q_cap = min(l_full, int(l_full * p + 6.0 * sigma_row) + 8)
+            hidden, labels, dropped = model.apply(
+                params, batch["input_ids"], batch["pad_mask"], rng=rng,
+                deterministic=deterministic, policy=policy,
+                return_hidden=True, query_capacity=q_cap)
+        else:
+            hidden, labels = model.apply(
+                params, batch["input_ids"], batch["pad_mask"], rng=rng,
+                deterministic=deterministic, policy=policy,
+                return_hidden=True)
         b, l, c = hidden.shape
         weight = (labels != IGNORE).astype(jnp.float32)
         valid = batch.get("valid")
@@ -182,8 +202,11 @@ class MaskedLanguageModelTask(TaskConfig):
         labels = labels.reshape(b * l)
         weight = weight.reshape(b * l)
         metrics = {}
-        if self.loss_impl in ("packed", "pallas"):
-            n = b * l
+        if packed:
+            # capacity tracks the FULL B·L position count (the masked
+            # total is Binomial(B·L, mask_p) no matter how the decoder
+            # rows were pre-packed per example)
+            n = b * l_full
             if self.packed_capacity is not None:
                 cap = int(n * min(self.packed_capacity, 1.0))
             else:
@@ -192,9 +215,12 @@ class MaskedLanguageModelTask(TaskConfig):
                 p = self.mask_p
                 sigma = (n * p * (1.0 - p)) ** 0.5
                 cap = int(n * p + 6.0 * sigma) + 8
-            cap = min(max(cap, 1), n)
+            cap = min(max(cap, 1), b * l)
             hidden, labels, weight, overflow = pack_positions(
                 hidden, labels, weight, cap)
+            # per-example pre-pack drops count exactly like global
+            # capacity overflow: contributing rows lost from the loss
+            overflow = overflow + dropped
             # overflow = contributing rows silently dropped by the
             # static capacity: it biases the loss, so it must be
             # observable — as a TB scalar (train_ce_overflow) and as a

@@ -21,22 +21,32 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-# (name, batch, n_q, n_kv, channels, heads) — encoder cross-attention
-# shapes of the BASELINE configs
+# (name, batch, n_q, n_kv, channels, heads) — cross-attention shapes
+# of the BASELINE configs. "seg" is the 262k-kv shape (32 latents ←
+# 512×512 input tokens, D=16) and "seg_dec" its decoder twin (262k
+# output queries ← 32 latents); "mlm2048"/"lm2048" are the seq-2048
+# A/B pair at production D=16 and wide D=64 — together the harvest
+# set for the flash-vs-chunked verdict (VERDICT r5 item 6).
 _SHAPES = {
     "mnist": (128, 32, 784, 128, 4),
     "mlm": (64, 64, 512, 64, 4),
     "imagenet": (8, 512, 50176, 512, 4),
     "seg": (4, 32, 262144, 64, 4),
+    "seg_dec": (1, 262144, 32, 64, 4),
     "lm2048": (4, 1024, 2048, 512, 8),
+    "mlm2048": (16, 64, 2048, 64, 4),
 }
 
 
 def main():
     impls = sys.argv[1:] or ["einsum", "chunked", "flash"]
     reps = int(os.environ.get("KERNEL_REPS", "20"))
+    # default harvest set = the shapes the flash-vs-chunked verdict
+    # needs (262k-kv + both seq-2048 widths), heaviest last so a short
+    # tunnel window still collects the small shapes
     names = [s for s in os.environ.get(
-        "KERNEL_SHAPES", "mnist,mlm,lm2048").split(",") if s]
+        "KERNEL_SHAPES",
+        "mnist,mlm,lm2048,mlm2048,seg,seg_dec").split(",") if s]
 
     import jax
     import jax.numpy as jnp

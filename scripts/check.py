@@ -43,8 +43,14 @@ def main() -> int:
                          "(package + scripts + entry points)")
     ap.add_argument("--json", default=None,
                     help="also write the report as JSON")
+    ap.add_argument("--rebaseline-hbm", action="store_true",
+                    help="re-measure every canonical target's "
+                         "cost-analysis bytes and rewrite the "
+                         "hbm_budgets.json manifest (only after an "
+                         "INTENTIONAL traffic change — commit the "
+                         "manifest diff with the justification)")
     args = ap.parse_args()
-    if not (args.all or args.lint or args.graph):
+    if not (args.all or args.lint or args.graph or args.rebaseline_hbm):
         args.all = True
 
     from perceiver_tpu.analysis import (
@@ -53,8 +59,32 @@ def main() -> int:
         Report,
         default_lint_paths,
         lint_paths,
+        lower_target,
         run_graph_checks,
+        write_hbm_budgets,
     )
+
+    if args.rebaseline_hbm:
+        import datetime
+        measured = {}
+        for target in CANONICAL_TARGETS:
+            print(f"[check] lowering {target.name} ...", file=sys.stderr)
+            lowered = lower_target(target)
+            if lowered.bytes_accessed is None:
+                print(f"[check] {target.name}: no cost analysis — "
+                      "cannot pin a budget", file=sys.stderr)
+                return 1
+            measured[target.name] = lowered.bytes_accessed
+            print(f"[check] {target.name}: "
+                  f"{lowered.bytes_accessed / 1e9:.2f} GB",
+                  file=sys.stderr)
+        write_hbm_budgets(
+            measured, note=str(datetime.date.today()))
+        print("[check] hbm_budgets.json rewritten — commit it with "
+              "the change that justified the re-baseline",
+              file=sys.stderr)
+        if not (args.all or args.lint or args.graph):
+            return 0
 
     report = Report()
     if args.all or args.lint:

@@ -44,16 +44,20 @@ def main():
     from perceiver_tpu.training import Trainer, TrainerConfig
 
     mesh = make_mesh(model_parallel=model_parallel)
+    # smallest config that still exercises every distributed code
+    # path: the test asserts collective consistency and stepping, not
+    # model capacity, and the 2-process compile+trace cost is paid
+    # twice per parametrization (test-suite budget, VERDICT r5 item 8)
     task = MaskedLanguageModelTask(
-        vocab_size=96, max_seq_len=32, num_latents=8,
-        num_latent_channels=16, num_encoder_layers=2,
-        num_encoder_self_attention_layers_per_block=2,
+        vocab_size=96, max_seq_len=16, num_latents=4,
+        num_latent_channels=16, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
         num_encoder_cross_attention_heads=2,
         num_encoder_self_attention_heads=2,
         num_decoder_cross_attention_heads=2, loss_impl="dense")
     dm = IMDBDataModule(data_dir=sys.argv[5], vocab_size=96,
-                        max_seq_len=32, batch_size=4,
-                        synthetic_train_size=64, synthetic_test_size=16)
+                        max_seq_len=16, batch_size=4,
+                        synthetic_train_size=16, synthetic_test_size=8)
     # SAME experiment dir on both processes: exercises the broadcast
     # version pick, the rank-0-only TB writer, and orbax's collective
     # multi-host checkpoint save into the shared directory

@@ -285,7 +285,8 @@ def test_ladder_climbs_smallest_first_and_flushes(bench, monkeypatch,
     monkeypatch.setattr(bench, "probe_backend", lambda: None)
     calls = []
 
-    def fake_run(b, inner, impl):
+    def fake_run(rung):
+        b, inner, impl = rung["batch"], rung["inner"], rung["loss"]
         calls.append((b, inner, impl))
         if b >= 256:
             raise RuntimeError("RESOURCE_EXHAUSTED: oom")
@@ -294,7 +295,8 @@ def test_ladder_climbs_smallest_first_and_flushes(bench, monkeypatch,
 
     monkeypatch.setattr(bench, "run", fake_run)
     bench.main()
-    # 512 skipped (over the 128 cap); dense at 64 still collected
+    # 512 packed + both 512 pallas winner rungs skipped (over the 128
+    # cap); dense at 64 still collected
     assert calls == [(64, 1, "packed"), (128, 4, "packed"),
                      (256, 8, "packed"), (64, 1, "dense")]
     values = [_json.loads(ln)["value"]
@@ -311,9 +313,10 @@ def test_ladder_falls_back_to_dense_when_packed_never_succeeds(
     monkeypatch.setattr(bench, "probe_backend", lambda: None)
     calls = []
 
-    def fake_run(b, inner, impl):
+    def fake_run(rung):
+        b, inner, impl = rung["batch"], rung["inner"], rung["loss"]
         calls.append((b, inner, impl))
-        if impl == "packed":
+        if impl in ("packed", "pallas"):
             raise RuntimeError("Mosaic lowering failed")
         return {"metric": "m", "value": 9.0, "unit": "u",
                 "vs_baseline": None, "detail": {"loss_impl": impl}}
@@ -321,7 +324,7 @@ def test_ladder_falls_back_to_dense_when_packed_never_succeeds(
     monkeypatch.setattr(bench, "run", fake_run)
     bench.main()
     assert calls[-1] == (64, 1, "dense")  # fallback reached
-    assert len(calls) == 5  # all packed rungs tried first
+    assert len(calls) == 7  # every packed/pallas rung tried first
     out = [_json.loads(ln)
            for ln in capsys.readouterr().out.splitlines()]
     assert out[-1]["value"] == 9.0

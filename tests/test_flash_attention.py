@@ -4,6 +4,8 @@ The Pallas kernel runs in interpreter mode on the CPU test backend —
 the identical kernel body that compiles on TPU (SURVEY.md §4 plan (c)).
 """
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -204,24 +206,110 @@ class TestMhaImpls:
             mha_apply(params, x, x, x, num_heads=2, attn_mask=mask,
                       impl="chunked")
 
-    def test_dropout_rejected(self):
-        params = mha_init(jax.random.key(0), q_dim=16, num_heads=2)
-        x = jnp.zeros((1, 4, 16))
-        with pytest.raises(NotImplementedError):
-            mha_apply(params, x, x, x, num_heads=2, dropout_rate=0.1,
-                      deterministic=False, rng=jax.random.key(1),
-                      impl="flash")
+    def test_dropout_degrades_to_chunked(self):
+        """dropout>0 on the flash impl degrades to the chunked path
+        (which streams attention-weight dropout exactly) with a
+        one-time warning, instead of raising (VERDICT r5 item 7)."""
+        import perceiver_tpu.ops.attention as attn_mod
 
-    def test_dropout_plus_flash_rejected_at_config_time(self):
-        """--model.dropout>0 with a non-dropout-capable impl must fail
-        when the task config is built, not deep inside a trace."""
+        params = mha_init(jax.random.key(0), q_dim=16, num_heads=2)
+        x = jax.random.normal(jax.random.key(2), (1, 8, 16))
+        rng = jax.random.key(1)
+        attn_mod._DROPOUT_DEGRADE_WARNED.clear()
+        with pytest.warns(UserWarning, match="falling back"):
+            out = mha_apply(params, x, x, x, num_heads=2,
+                            dropout_rate=0.1, deterministic=False,
+                            rng=rng, impl="flash", kv_chunk_size=4)
+        ref = mha_apply(params, x, x, x, num_heads=2, dropout_rate=0.1,
+                        deterministic=False, rng=rng, impl="chunked",
+                        kv_chunk_size=4)
+        np.testing.assert_allclose(out, ref, atol=1e-6, rtol=1e-6)
+        # the warning fires once per impl per process
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            mha_apply(params, x, x, x, num_heads=2, dropout_rate=0.1,
+                      deterministic=False, rng=rng, impl="flash",
+                      kv_chunk_size=4)
+        # deterministic (eval) calls keep the flash kernel — no dropout
+        # is applied, so nothing to degrade for
+        mha_apply(params, x, x, x, num_heads=2, dropout_rate=0.1,
+                  deterministic=True, impl="flash", kv_chunk_size=4)
+
+    def test_dropout_plus_flash_warns_at_config_time(self):
+        """--model.dropout>0 with a non-dropout-capable impl constructs
+        fine (the impl degrades to chunked at trace time) but warns
+        when the task config is built, so the degrade is visible before
+        the first trace."""
+        import perceiver_tpu.ops.attention as attn_mod
+
         from perceiver_tpu.tasks.image import ImageClassifierTask
-        with pytest.raises(ValueError, match="dropout"):
+        attn_mod._DROPOUT_DEGRADE_WARNED.clear()
+        with pytest.warns(UserWarning, match="falling back"):
             ImageClassifierTask(image_shape=(28, 28, 1), num_classes=10,
                                 dropout=0.1, attention_impl="flash")
-        # dropout-capable impls still construct fine
-        ImageClassifierTask(image_shape=(28, 28, 1), num_classes=10,
-                            dropout=0.1, attention_impl="chunked")
+        # dropout-capable impls construct silently
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            ImageClassifierTask(image_shape=(28, 28, 1), num_classes=10,
+                                dropout=0.1, attention_impl="chunked")
+
+
+class TestDropoutTracesUnderEveryImpl:
+    """A dropout>0 config must trace a train step under EVERY
+    attention impl (VERDICT r5 item 7): the non-dropout-capable
+    kernels degrade to chunked instead of raising mid-trace."""
+
+    def _tiny_task(self, impl, decoder_impl=None):
+        from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+        return MaskedLanguageModelTask(
+            vocab_size=96, max_seq_len=32, num_latents=8,
+            num_latent_channels=16, num_encoder_layers=1,
+            num_encoder_self_attention_layers_per_block=1,
+            num_encoder_cross_attention_heads=2,
+            num_encoder_self_attention_heads=2,
+            num_decoder_cross_attention_heads=2, dropout=0.1,
+            attention_impl=impl, decoder_attention_impl=decoder_impl,
+            kv_chunk_size=16, loss_impl="dense")
+
+    @pytest.mark.parametrize("impl", [None, "einsum", "chunked",
+                                      "flash", "seqpar", "ring",
+                                      "ulysses"])
+    def test_train_step_traces(self, impl):
+        import perceiver_tpu.ops.attention as attn_mod
+
+        from perceiver_tpu.ops.policy import Policy
+
+        attn_mod._DROPOUT_DEGRADE_WARNED.clear()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            task = self._tiny_task(impl, decoder_impl="flash")
+            if impl in ("seqpar", "ring", "ulysses"):
+                from perceiver_tpu.parallel import make_mesh
+                model = task.build(mesh=make_mesh(
+                    8, seq_parallel=2, model_parallel=1))
+            else:
+                model = task.build()
+        params = model.init(jax.random.key(0))
+        rng = np.random.default_rng(0)
+        batch = {
+            "input_ids": jnp.asarray(
+                rng.integers(3, 96, (2, 32)), jnp.int32),
+            "pad_mask": jnp.zeros((2, 32), bool),
+        }
+
+        def step(p):
+            def loss_fn(p):
+                loss, _ = task.loss_and_metrics(
+                    model, p, batch, rng=jax.random.key(3),
+                    deterministic=False, policy=Policy.fp32())
+                return loss
+
+            return jax.value_and_grad(loss_fn)(p)
+
+        # trace + lower (no compile/run: the degrade fires at trace
+        # time, which is where the old NotImplementedError lived)
+        jax.jit(step).lower(params)
 
 
 class TestChunkedDropout:

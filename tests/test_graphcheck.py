@@ -26,12 +26,14 @@ from perceiver_tpu.analysis import (
     TransferAllow,
     donation_check,
     dtype_policy,
+    hbm_budget,
     hlo,
     lint_source,
-    lower_target,
+    load_hbm_budgets,
     recompile_budget,
     run_graph_checks,
     transfer_guard,
+    write_hbm_budgets,
 )
 
 
@@ -218,6 +220,93 @@ def test_recompile_budget_fails_on_drifting_shapes():
                for v in violations)
 
 
+# --- hbm_budget -------------------------------------------------------------
+
+
+def test_hbm_budget_fails_on_seeded_regression():
+    # a step whose cost-analysis bytes exceed the pinned budget — the
+    # exact shape of a re-materialized residual or fp32 copy landing
+    budgets = {"seeded": {"budget_bytes": 1_000_000,
+                          "pinned_bytes": 952_381, "pinned": "test"}}
+    violations = hbm_budget(2_000_000.0, where="seeded", budgets=budgets)
+    assert violations
+    assert "exceeds the pinned budget" in violations[0].message
+    assert "+110.0%" in violations[0].message
+
+
+def test_hbm_budget_passes_within_budget():
+    budgets = {"seeded": {"budget_bytes": 1_000_000,
+                          "pinned_bytes": 952_381, "pinned": "test"}}
+    assert not hbm_budget(999_999.0, where="seeded", budgets=budgets)
+
+
+def test_hbm_budget_fails_on_missing_budget():
+    # an unbudgeted canonical target must FAIL, not silently opt out
+    # of the traffic gate (same for a deleted/unreadable manifest,
+    # which loads as an empty dict)
+    violations = hbm_budget(1.0, where="new_target", budgets={})
+    assert violations
+    assert "no byte budget pinned" in violations[0].message
+
+
+def test_hbm_budget_fails_without_cost_analysis():
+    # a backend exposing no lowering-time cost analysis cannot certify
+    # the budget — that must be a loud violation, not a silent pass
+    budgets = {"seeded": {"budget_bytes": 1_000_000,
+                          "pinned_bytes": 952_381, "pinned": "test"}}
+    violations = hbm_budget(None, where="seeded", budgets=budgets)
+    assert violations
+    assert "no cost analysis" in violations[0].message
+
+
+def test_hbm_budget_manifest_roundtrip(tmp_path):
+    path = str(tmp_path / "budgets.json")
+    manifest = write_hbm_budgets({"a": 100.0, "b": 200.0}, path=path,
+                                 note="test")
+    loaded = load_hbm_budgets(path)
+    assert loaded == manifest["targets"]
+    assert loaded["a"]["pinned_bytes"] == 100
+    assert loaded["a"]["budget_bytes"] == 105  # 5% headroom
+    # the checked-in manifest budgets every canonical target
+    pinned = load_hbm_budgets()
+    assert {t.name for t in CANONICAL_TARGETS} <= set(pinned)
+
+
+def test_hbm_budget_seeded_violation_through_runner(
+        tmp_path, monkeypatch, lowered_target_cache):
+    """End-to-end: shrink the checked-in budget for a real canonical
+    target and the full runner must report a violation — proof the
+    merge gate actually trips on a traffic regression."""
+    import json as _json
+
+    import perceiver_tpu.analysis.passes as passes_mod
+
+    with open(passes_mod._HBM_MANIFEST) as f:
+        manifest = _json.load(f)
+    name = CANONICAL_TARGETS[0].name
+    manifest["targets"][name]["budget_bytes"] = 1  # nothing fits in 1 B
+    path = str(tmp_path / "budgets.json")
+    with open(path, "w") as f:
+        _json.dump(manifest, f)
+    monkeypatch.setattr(passes_mod, "_HBM_MANIFEST", path)
+    # recompile=False reads each lowering once — safe to serve from
+    # the session cache (the recompile-closure pass is not in play)
+    monkeypatch.setattr(passes_mod, "lower_target", lowered_target_cache)
+    report = run_graph_checks([CANONICAL_TARGETS[0]], recompile=False)
+    assert not report.ok
+    assert any(v.check == "hbm_budget" and v.where == name
+               for v in report.violations)
+
+
+def test_headline_hbm_bytes_pinned_below_baseline():
+    """The round-6 traffic work's acceptance number, pinned forever:
+    the headline B=512/C=64 MLM step's cost-analysis bytes must stay
+    ≥25% below the pre-PR baseline of 133.0 GB (the bf16 scan carries
+    + attention recompute + packed masked-position decode win)."""
+    pinned = load_hbm_budgets()["mlm_b512_c64_packed"]
+    assert pinned["budget_bytes"] < 0.75 * 133.0e9
+
+
 # --- lint rules -------------------------------------------------------------
 
 
@@ -378,13 +467,13 @@ def test_lint_clean_on_fixed_tree_files():
 # --- headline regression + full sweep ---------------------------------------
 
 
-def test_headline_config_bf16_flop_fraction_is_one():
+def test_headline_config_bf16_flop_fraction_is_one(lowered_target_cache):
     """B=512/C=64 packed MLM (bench.py _LADDER[0]): every dot FLOP in
     the lowered train step runs on bf16 operands — the round-4 audit's
     9.1%-at-fp32 regression, pinned forever."""
     target = CANONICAL_TARGETS[0]
     assert target.name == "mlm_b512_c64_packed" and target.headline
-    lowered = lower_target(target)
+    lowered = lowered_target_cache(target)
     summary = hlo.dot_flop_summary(list(hlo.iter_dots(lowered.text)))
     assert summary["bf16_flop_fraction"] == 1.0
     violations, _ = dtype_policy(lowered.text, where=target.name,
@@ -397,15 +486,49 @@ def test_headline_config_bf16_flop_fraction_is_one():
                               allowlist=target.transfer_allow)
 
 
-def test_full_graph_sweep_is_clean():
+def test_full_graph_sweep_is_clean(monkeypatch, lowered_target_cache):
     """What ``scripts/check.py --graph`` gates at merge: every
-    canonical target, all four passes including the double-lowering
-    recompile check. Slow-marked (see conftest)."""
+    canonical target, all five passes including the double-lowering
+    recompile check. Slow-marked (see conftest). The FIRST lowering
+    per target comes from the session cache; the recompile pass's
+    second lowering stays a real rebuild, so the closure check
+    compares cache-vs-fresh — the cross-rebuild property it exists
+    for — without paying every lowering twice."""
+    import perceiver_tpu.analysis.passes as passes_mod
+    from perceiver_tpu.analysis.targets import lower_target as real_lower
+
+    first_seen = set()
+
+    def once_cached(target):
+        if target.name not in first_seen:
+            first_seen.add(target.name)
+            return lowered_target_cache(target)
+        return real_lower(target)
+
+    monkeypatch.setattr(passes_mod, "lower_target", once_cached)
     report = run_graph_checks(CANONICAL_TARGETS, recompile=True)
     assert report.ok, report.format()
     assert set(report.checks_run) == {"dtype_policy", "transfer_guard",
                                       "donation_check",
-                                      "recompile_budget"}
+                                      "recompile_budget", "hbm_budget"}
+
+
+def test_check_cli_all_exits_zero():
+    """``scripts/check.py --all`` — the literal merge gate, as the
+    literal subprocess CI runs — exits 0 on this tree. Tier-1 (not
+    slow-marked): graphcheck + hbm_budget only gate merges if the
+    fast suite actually runs them."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "check.py"),
+         "--all"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
 
 
 def test_full_lint_sweep_is_clean():

@@ -115,15 +115,18 @@ def test_mlm_train_step_on_dp_tp_mesh(tp):
     """Reduced Perceiver-LM over (8/tp)×tp mesh: finite loss, and q/fc1
     weights really sharded over the model axis."""
     mesh = make_mesh(8, model_parallel=tp)
+    # structure-faithful minimum: the assertions check sharding layout
+    # and a finite loss, not capacity — depth/seq only pad the GSPMD
+    # compile (test-suite budget, VERDICT r5 item 8)
     task = MaskedLanguageModelTask(
-        vocab_size=256, max_seq_len=64, num_latents=16,
+        vocab_size=256, max_seq_len=32, num_latents=8,
         num_latent_channels=32,
-        num_encoder_self_attention_layers_per_block=2,
+        num_encoder_self_attention_layers_per_block=1,
         num_encoder_cross_attention_heads=4,
         num_encoder_self_attention_heads=4,
         num_decoder_cross_attention_heads=4)
     params, loss = _mlm_step(task, mesh, batch_size=mesh.shape["data"] * 2,
-                             seq_len=64, vocab=256)
+                             seq_len=32, vocab=256)
     assert np.isfinite(loss)
 
     def find_q(tree):
@@ -151,15 +154,15 @@ def test_text_classifier_dp8_step():
     """BASELINE configs[2]: seq_clf pure-DP over 8 devices."""
     mesh = make_mesh(8, model_parallel=1)
     task = TextClassifierTask(
-        vocab_size=256, max_seq_len=64, num_latents=16,
+        vocab_size=256, max_seq_len=32, num_latents=8,
         num_latent_channels=32)
     model = task.build()
     params = shard_params(model.init(jax.random.key(0)), mesh)
     bshard = batch_sharding(mesh)
     rng = np.random.default_rng(0)
     ids = jax.device_put(
-        rng.integers(3, 256, (16, 64)).astype(np.int32), bshard)
-    pad = jax.device_put(np.zeros((16, 64), bool), bshard)
+        rng.integers(3, 256, (16, 32)).astype(np.int32), bshard)
+    pad = jax.device_put(np.zeros((16, 32), bool), bshard)
     labels = jax.device_put(
         rng.integers(0, 2, (16,)).astype(np.int32), bshard)
 
@@ -184,18 +187,18 @@ def test_mlm_seq_parallel_matches_replicated():
     from perceiver_tpu.parallel import seq_sharding
 
     task = MaskedLanguageModelTask(
-        vocab_size=128, max_seq_len=128, num_latents=8,
+        vocab_size=128, max_seq_len=64, num_latents=8,
         num_latent_channels=32,
-        num_encoder_self_attention_layers_per_block=2,
+        num_encoder_self_attention_layers_per_block=1,
         num_encoder_cross_attention_heads=4,
         num_encoder_self_attention_heads=4,
         num_decoder_cross_attention_heads=4)
     model = task.build()
     params = model.init(jax.random.key(0))
     rng = np.random.default_rng(0)
-    ids_np = rng.integers(3, 128, (4, 128)).astype(np.int32)
-    pad_np = np.zeros((4, 128), bool)
-    pad_np[:, 120:] = True  # exercise the masked-kv path across shards
+    ids_np = rng.integers(3, 128, (4, 64)).astype(np.int32)
+    pad_np = np.zeros((4, 64), bool)
+    pad_np[:, 56:] = True  # exercise the masked-kv path across shards
 
     def loss_fn(p, ids, pad):
         logits, _ = model.apply(p, ids, pad, masking=False, policy=FP32)

@@ -20,10 +20,14 @@ ADAMW = {"class_path": "AdamW", "init_args": {"lr": 1e-3}}
 
 
 def small_image_task():
+    # 2 encoder layers keeps the weight-shared layer scan in the
+    # trainer path; 1 self-attn layer/block and 8 latents are the
+    # compile-cost floor for the structure these tests assert
+    # (test-suite budget, VERDICT r5 item 8)
     return ImageClassifierTask(
         image_shape=(28, 28, 1), num_classes=10, num_frequency_bands=8,
-        num_latents=16, num_latent_channels=32, num_encoder_layers=2,
-        num_encoder_self_attention_layers_per_block=2,
+        num_latents=8, num_latent_channels=32, num_encoder_layers=2,
+        num_encoder_self_attention_layers_per_block=1,
         num_decoder_cross_attention_heads=1)
 
 
