@@ -32,9 +32,11 @@ from perceiver_tpu.analysis.passes import (  # noqa: F401
 from perceiver_tpu.analysis.targets import (  # noqa: F401
     CANONICAL_TARGETS,
     FAST_TARGETS,
+    SERVING_TARGETS,
     StepTarget,
     cost_bytes_accessed,
     lower_target,
+    make_serve_step,
     make_train_step,
 )
 from perceiver_tpu.analysis.lint import (  # noqa: F401

@@ -35,6 +35,17 @@ Rules (names are the ``check`` field of emitted violations):
     count. An unvalidated value fails deep inside a jit trace instead
     of at config time (ADVICE r5 on ``tasks/base.py``).
 
+``serving-host-sync``
+    Device synchronization inside ``serving/engine.py``: ``.item()``,
+    ``.tolist()``, ``.block_until_ready()``, ``jax.device_get``, and
+    numpy conversion calls (``np.asarray``/``np.array``/``np.copy``/
+    ``np.ascontiguousarray``) anywhere in the engine module. The
+    engine's dispatch path must stay sync-free so dispatches pipeline
+    like train steps; materializing results — and timing them —
+    belongs to the consumer layer (``serving/api.py``, the batcher).
+    Scoped to the whole engine module on purpose: a sync in a helper
+    called from dispatch stalls the pipeline exactly the same way.
+
 Tracing detection is local and conservative: functions decorated with
 ``jax.jit`` / ``partial(jax.jit, ...)``, functions passed to a
 ``jax.jit(...)`` call anywhere in the module, and everything nested
@@ -314,6 +325,47 @@ def _check_impl_fields(cls: ast.ClassDef, path: str) -> List[Violation]:
     return out
 
 
+# serving/engine.py: the sync-free dispatch contract (docs/SERVING.md)
+_ENGINE_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_NUMPY_CONVERSIONS = {"asarray", "array", "copy", "ascontiguousarray"}
+
+
+def _check_engine_syncs(tree: ast.AST, imports: _Imports,
+                        path: str) -> List[Violation]:
+    """``serving-host-sync``: no device→host synchronization anywhere
+    in the serving engine module (see module docstring)."""
+    out: List[Violation] = []
+
+    def add(node, what, hint):
+        out.append(Violation(
+            check="serving-host-sync", where=f"{path}:{node.lineno}",
+            message=f"{what} in serving/engine.py — the engine "
+                    "dispatch path must never synchronize on device "
+                    f"values; {hint}"))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _ENGINE_SYNC_ATTRS:
+            add(node, f".{func.attr}()",
+                "materialize results in serving/api.py instead")
+            continue
+        chain = _attr_chain(func)
+        if chain and chain[-1] == "device_get":
+            add(node, "device_get()",
+                "hand device arrays to the consumer layer instead")
+            continue
+        root = _attr_root(func)
+        if root in imports.numpy and len(chain) == 2 \
+                and chain[1] in _NUMPY_CONVERSIONS:
+            add(node, f"{'.'.join(chain)}() on a potential device array",
+                "numpy conversion forces a transfer — convert in "
+                "serving/api.materialize")
+    return out
+
+
 def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     """Lint one module's source. ``path`` is used for reporting and
     for the ops-scoped rule (a path containing ``/ops/``)."""
@@ -323,6 +375,8 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     violations: List[Violation] = []
 
     norm = path.replace(os.sep, "/")
+    if norm.endswith("serving/engine.py"):
+        violations.extend(_check_engine_syncs(tree, imports, path))
     if "/ops/" in norm and {"numpy", "jax.numpy"} <= imports.top_level:
         lineno = next((n.lineno for n in tree.body
                        if isinstance(n, (ast.Import, ast.ImportFrom))), 1)
@@ -373,7 +427,7 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
 
 
 ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
-             "impl-field-validation")
+             "impl-field-validation", "serving-host-sync")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

@@ -176,11 +176,16 @@ def load_hbm_budgets(path: Optional[str] = None) -> Dict[str, dict]:
 def write_hbm_budgets(measured: Dict[str, float],
                       path: Optional[str] = None,
                       headroom: float = _HBM_HEADROOM,
-                      note: str = "") -> dict:
+                      note: str = "",
+                      keep: Optional[Dict[str, dict]] = None) -> dict:
     """Re-baseline: pin each target's measured bytes and derive its
     budget. Only for INTENTIONAL traffic changes — see docs/ANALYSIS.md
     for the re-baseline protocol (the diff of this file is the audit
-    trail of every accepted regression or win)."""
+    trail of every accepted regression or win).
+
+    ``keep`` carries already-pinned entries to copy through verbatim —
+    the ``--pin-missing-hbm`` path, which budgets newly added targets
+    without silently re-baselining the existing ones."""
     manifest = {
         "_comment": (
             "hbm_budget manifest — XLA cost-analysis 'bytes accessed' "
@@ -189,14 +194,14 @@ def write_hbm_budgets(measured: Dict[str, float],
             f"{headroom}. Re-baseline via scripts/check.py "
             "--rebaseline-hbm after an intentional change; never edit "
             "budgets by hand to make a regression pass."),
-        "targets": {
-            name: {
+        "targets": dict(sorted({
+            **(keep or {}),
+            **{name: {
                 "budget_bytes": int(value * headroom),
                 "pinned_bytes": int(value),
                 "pinned": note,
-            }
-            for name, value in sorted(measured.items())
-        },
+            } for name, value in measured.items()},
+        }.items())),
     }
     with open(path or _HBM_MANIFEST, "w") as f:
         json.dump(manifest, f, indent=1)

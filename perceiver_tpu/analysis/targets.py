@@ -44,6 +44,12 @@ class StepTarget:
     ``build`` returns a fresh ``(task, batch)`` every call — the
     recompile-budget pass relies on independent rebuilds producing
     byte-identical step signatures.
+
+    ``kind`` selects what gets lowered: ``"train"`` is the full
+    forward + backward + AdamW step (``make_train_step``); ``"serve"``
+    is the task's serve graph (``serving/graphs.py``) at its bucket
+    shapes — the exact executable ``ServingEngine`` AOT-compiles, so
+    the gates certify the graph production dispatches.
     """
 
     name: str
@@ -52,6 +58,7 @@ class StepTarget:
     headline: bool = False
     transfer_allow: Tuple[TransferAllow, ...] = ()
     dtype_allow: Tuple[DtypeAllow, ...] = ()
+    kind: str = "train"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,16 +124,38 @@ def make_train_step(task, batch):
     return train_step, (params, opt_state, batch, jax.random.key(1))
 
 
+def make_serve_step(task, batch):
+    """The canonical serve-graph jit for a task: the same function —
+    with the same donation layout — that ``ServingEngine`` AOT-compiles
+    per bucket. Returns ``(jitted_fn, args, expected_donated)``; only
+    the donated request buffers (which alias outputs by construction,
+    see serving/graphs.py) count toward ``expected_donated``."""
+    import jax
+
+    from perceiver_tpu.serving.graphs import build_serve_graph
+
+    graph = build_serve_graph(task)
+    params = graph.init_params()
+    args = (params,) + tuple(batch[spec.name] for spec in graph.inputs)
+    jitted = jax.jit(graph.fn, donate_argnums=graph.donate_argnums)
+    donated_args = tuple(args[i] for i in graph.donate_argnums)
+    expected = len(jax.tree_util.tree_leaves(donated_args))
+    return jitted, args, expected
+
+
 def lower_target(target: StepTarget) -> LoweredStep:
-    """Build the target's task + batch, lower its train step, and
-    package the properties the graph passes gate on."""
+    """Build the target's task + batch, lower its step (train or
+    serve), and package the properties the graph passes gate on."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     task, batch = target.build()
-    step, args = make_train_step(task, batch)
-    params, opt_state = args[0], args[1]
-    expected = len(jax.tree_util.tree_leaves((params, opt_state)))
+    if target.kind == "serve":
+        step, args, expected = make_serve_step(task, batch)
+    else:
+        step, args = make_train_step(task, batch)
+        params, opt_state = args[0], args[1]
+        expected = len(jax.tree_util.tree_leaves((params, opt_state)))
     lowered = step.lower(*args)
     return LoweredStep(target=target, text=lowered.as_text(),
                        expected_donated=expected, task_hash=hash(task),
@@ -213,17 +242,100 @@ def _build_seg(batch: int = 1, side: int = 512):
     return task, data
 
 
+# --------------------------------------------------------------------------
+# Serving targets: the serve graph of each task at its largest default
+# engine bucket (serving/engine.py defaults: batch ≤ 32, seq ≤ 512 for
+# the canonical text recipe) — the shapes steady-state traffic pads
+# into, so the budget/dtype/transfer/donation/recompile gates certify
+# the executable production actually dispatches. Forward-only, so all
+# four lower in seconds.
+
+def _serve_batch_mlm(batch: int = 32, seq_len: int = 512,
+                     vocab: int = 10003, channels: int = 64):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+    from perceiver_tpu.tokenizer import MASK_TOKEN_ID
+
+    task = MaskedLanguageModelTask(
+        vocab_size=vocab, max_seq_len=seq_len,
+        num_latent_channels=channels)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, vocab, (batch, seq_len))
+    ids[:, ::7] = MASK_TOKEN_ID  # representative fill-mask density
+    return task, {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "pad_mask": jnp.zeros((batch, seq_len), bool),
+    }
+
+
+def _serve_batch_text_clf(batch: int = 32, seq_len: int = 512,
+                          vocab: int = 10003):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_tpu.tasks import TextClassifierTask
+
+    task = TextClassifierTask(vocab_size=vocab, max_seq_len=seq_len)
+    rng = np.random.default_rng(0)
+    return task, {
+        "input_ids": jnp.asarray(
+            rng.integers(3, vocab, (batch, seq_len)), jnp.int32),
+        "pad_mask": jnp.zeros((batch, seq_len), bool),
+    }
+
+
+def _serve_batch_img_clf(batch: int = 32):
+    import jax.numpy as jnp
+    import numpy as np
+
+    task, _ = _build_img_clf(batch=batch)
+    rng = np.random.default_rng(0)
+    return task, {
+        "image": jnp.asarray(rng.normal(0, 1, (batch, 28, 28, 1)),
+                             jnp.float32),
+    }
+
+
+def _serve_batch_seg(batch: int = 1, side: int = 512):
+    import jax.numpy as jnp
+    import numpy as np
+
+    task, _ = _build_seg(batch=batch, side=side)
+    rng = np.random.default_rng(0)
+    img = (rng.random((batch, side, side))
+           * (rng.random((batch, side, side)) < 0.01))
+    return task, {"image": jnp.asarray(img, jnp.float32)}
+
+
+SERVING_TARGETS = (
+    # headline: the serve graph is pure forward under Policy.bf16 —
+    # every dot FLOP must run on bf16 operands, same bar as the
+    # headline train step
+    StepTarget(name="serve_mlm_b32_s512", build=_serve_batch_mlm,
+               kind="serve", headline=True),
+    StepTarget(name="serve_text_clf_b32_s512",
+               build=_serve_batch_text_clf, kind="serve"),
+    StepTarget(name="serve_img_clf_b32", build=_serve_batch_img_clf,
+               kind="serve"),
+    StepTarget(name="serve_seg_512x512_b1", build=_serve_batch_seg,
+               kind="serve"),
+)
+
+
 # The headline MLM rung (bench.py _LADDER[0]: B=512/C=64/packed) plus
-# one target per remaining task at its canonical shapes. "fast" targets
-# keep tracing under a few seconds for the tier-1 subset; --all adds
-# the expensive ones (the 262k-query segmentation decoder).
+# one target per remaining task at its canonical shapes, plus the
+# serving targets. "fast" targets keep tracing under a few seconds for
+# the tier-1 subset; --all adds the expensive ones (the 262k-query
+# segmentation train step — its forward-only serve twin stays fast).
 CANONICAL_TARGETS = (
     StepTarget(name="mlm_b512_c64_packed", build=_build_mlm,
                headline=True, transfer_allow=_MLM_OVERFLOW_CALLBACK),
     StepTarget(name="text_clf_b64", build=_build_text_clf),
     StepTarget(name="img_clf_b512", build=_build_img_clf),
     StepTarget(name="seg_512x512_b1", build=_build_seg),
-)
+) + SERVING_TARGETS
 
 FAST_TARGETS = tuple(t for t in CANONICAL_TARGETS
                      if t.name != "seg_512x512_b1")

@@ -1,0 +1,39 @@
+"""Serving subsystem: AOT bucketed-batch inference (docs/SERVING.md).
+
+Layers, bottom to top:
+
+- ``graphs``  — per-task serve computations (the single source of
+  truth the engine compiles AND ``analysis/targets.py`` gates);
+- ``engine``  — checkpoint loading, per-(batch, seq) bucket AOT
+  compilation, device-resident params, sync-free dispatch;
+- ``batcher`` — thread-safe micro-batching queue with deadlines and
+  typed ``Overloaded`` load shedding;
+- ``metrics`` — counters/gauges/latency histograms with Prometheus
+  text exposition;
+- ``api``     — task front-ends (MLM fill-mask, text/image
+  classification, segmentation) and the ``predict_masked_samples``
+  compat path.
+"""
+
+from perceiver_tpu.serving.batcher import (  # noqa: F401
+    MicroBatcher,
+    Overloaded,
+)
+from perceiver_tpu.serving.engine import (  # noqa: F401
+    RequestTooLarge,
+    ServeResult,
+    ServingEngine,
+)
+from perceiver_tpu.serving.graphs import (  # noqa: F401
+    ServeGraph,
+    build_serve_graph,
+    mlm_serve_graph,
+)
+from perceiver_tpu.serving.metrics import MetricsRegistry  # noqa: F401
+from perceiver_tpu.serving.api import (  # noqa: F401
+    ImageClassifierServer,
+    MLMServer,
+    SegmentationServer,
+    TextClassifierServer,
+    materialize,
+)

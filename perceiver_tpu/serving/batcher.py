@@ -1,0 +1,200 @@
+"""Thread-safe micro-batching queue with deadlines and load shedding.
+
+Serving traffic arrives one request at a time, but the engine's
+executables want bucket-shaped batches — the batcher sits between:
+concurrent ``submit`` calls enqueue single requests, a worker thread
+coalesces them into batches of up to ``max_batch`` (waiting at most
+``max_delay_ms`` after the first request of a batch), and hands each
+batch to the runner callable.
+
+Overload semantics are explicit and typed, never an unbounded queue:
+
+- a ``submit`` while the queue already holds ``max_depth`` requests is
+  shed immediately with ``Overloaded("queue_full")``;
+- a request whose per-request deadline (``timeout_ms``) expires while
+  it waits in the queue is shed with ``Overloaded("deadline")`` at
+  service time, *before* any compute is spent on it;
+- runner exceptions fail only the requests in that batch (delivered
+  via the future), never the worker loop.
+
+Under saturation the queue depth is therefore bounded by
+``max_depth``, latency of *accepted* requests is bounded by their
+deadline, and excess load degrades to typed shed results the caller
+can turn into HTTP 429s — the standard TPU-serving answer to the
+"compile a few buckets, keep them full" regime this subsystem
+implements (see docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+from perceiver_tpu.serving.metrics import MetricsRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class Overloaded:
+    """Typed shed result: the request was NOT served.
+
+    ``reason`` is ``"queue_full"`` (shed at submit) or ``"deadline"``
+    (expired while queued). ``queue_depth`` is the depth observed when
+    the decision was made — the caller's backpressure signal.
+    """
+
+    reason: str
+    queue_depth: int
+
+
+@dataclasses.dataclass
+class _Pending:
+    payload: object
+    future: Future
+    enqueued_at: float
+    deadline: Optional[float]  # absolute monotonic seconds, or None
+
+
+class MicroBatcher:
+    """Coalesce concurrent requests into runner-sized batches.
+
+    ``runner(payloads)`` receives 1..max_batch payloads in submission
+    order and returns one result per payload (same order). Results —
+    or the runner's exception, or an ``Overloaded`` — resolve each
+    request's future.
+    """
+
+    def __init__(self, runner: Callable[[List[object]], Sequence[object]],
+                 *, max_batch: int = 8, max_delay_ms: float = 2.0,
+                 max_depth: int = 64,
+                 metrics: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_batch < 1 or max_depth < 1:
+            raise ValueError("max_batch and max_depth must be >= 1")
+        self._runner = runner
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1000.0
+        self.max_depth = max_depth
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._queue: collections.deque = collections.deque()
+        self._closed = False
+
+        m = metrics if metrics is not None else MetricsRegistry()
+        self.metrics = m
+        self._m_depth = m.gauge("serving_queue_depth",
+                                "requests waiting in the batcher queue")
+        self._m_shed = m.counter("serving_shed_total",
+                                 "requests shed, by reason")
+        self._m_latency = m.histogram(
+            "serving_request_latency_seconds",
+            "submit → result latency of served requests")
+        self._m_batch = m.histogram(
+            "serving_batch_size", "coalesced requests per runner call",
+            buckets=tuple(float(x) for x in (1, 2, 4, 8, 16, 32, 64)))
+        self._m_served = m.counter("serving_requests_total",
+                                   "requests whose future resolved, "
+                                   "by outcome")
+
+        self._worker = threading.Thread(target=self._loop,
+                                        name="micro-batcher", daemon=True)
+        self._worker.start()
+
+    # -- client side ------------------------------------------------------
+
+    def submit(self, payload, *, timeout_ms: Optional[float] = None
+               ) -> Future:
+        """Enqueue one request. The future resolves to the runner's
+        result for it, an ``Overloaded``, or raises the runner's error.
+        """
+        now = self._clock()
+        fut = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._queue) >= self.max_depth:
+                depth = len(self._queue)
+                self._m_shed.labels(reason="queue_full").inc()
+                self._m_served.labels(outcome="shed").inc()
+                fut.set_result(Overloaded("queue_full", depth))
+                return fut
+            deadline = (now + timeout_ms / 1000.0
+                        if timeout_ms is not None else None)
+            self._queue.append(_Pending(payload, fut, now, deadline))
+            self._m_depth.set(len(self._queue))
+            self._not_empty.notify()
+        return fut
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the worker; queued requests still drain first."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+        self._worker.join(timeout)
+
+    # -- worker side ------------------------------------------------------
+
+    def _take_batch(self) -> Optional[List[_Pending]]:
+        """Block for the first request, then gather until ``max_batch``
+        or ``max_delay`` past the first. None = closed and drained."""
+        with self._not_empty:
+            while not self._queue and not self._closed:
+                self._not_empty.wait(0.1)
+            if not self._queue:
+                return None  # closed
+            batch = [self._queue.popleft()]
+            batch_deadline = self._clock() + self.max_delay
+            while len(batch) < self.max_batch:
+                if self._queue:
+                    batch.append(self._queue.popleft())
+                    continue
+                remaining = batch_deadline - self._clock()
+                if remaining <= 0 or self._closed:
+                    break
+                self._not_empty.wait(remaining)
+            self._m_depth.set(len(self._queue))
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            now = self._clock()
+            live: List[_Pending] = []
+            for p in batch:
+                if p.deadline is not None and now > p.deadline:
+                    self._m_shed.labels(reason="deadline").inc()
+                    self._m_served.labels(outcome="shed").inc()
+                    p.future.set_result(
+                        Overloaded("deadline", len(batch)))
+                else:
+                    live.append(p)
+            if not live:
+                continue
+            try:
+                results = self._runner([p.payload for p in live])
+                if len(results) != len(live):
+                    raise RuntimeError(
+                        f"runner returned {len(results)} results for "
+                        f"{len(live)} requests")
+            except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+                for p in live:
+                    self._m_served.labels(outcome="error").inc()
+                    p.future.set_exception(e)
+                continue
+            done = self._clock()
+            self._m_batch.observe(float(len(live)))
+            for p, r in zip(live, results):
+                self._m_latency.observe(done - p.enqueued_at)
+                self._m_served.labels(outcome="ok").inc()
+                p.future.set_result(r)

@@ -1,0 +1,328 @@
+"""AOT bucketed-batch inference engine.
+
+The repo's previous inference entry (``utils/predict.py``) re-created
+a lambda per call — a fresh jit cache key, i.e. a full XLA recompile
+per request — and round-tripped every intermediate through the host.
+TPU serving stacks instead compile a *small, closed set* of padded
+shape buckets ahead of time and coalesce traffic into them (PAPERS:
+Gemma-on-TPU serving; ragged paged attention): compilation happens
+once at startup, dispatch is a dictionary lookup plus a pad, and the
+steady state performs **zero** XLA compiles.
+
+``ServingEngine`` implements that contract:
+
+- one AOT executable per (batch-bucket, seq-bucket), built with
+  ``jax.jit(...).lower(...).compile()`` at startup (``warmup``);
+- params restored once (``training/checkpoint.restore_params``) and
+  kept device-resident; ``update_params`` swaps weights without any
+  recompile (same shapes → same executables);
+- requests dispatch to the smallest fitting bucket, padded with inert
+  values (PAD tokens / masked key positions / zero pixels);
+- the MLM graph donates its request buffers (they alias the
+  ``filled_ids``/``is_masked`` outputs — see ``serving/graphs.py``).
+
+Host-sync discipline: ``dispatch`` never synchronizes on device
+values — no ``.item()``/``.tolist()``/``block_until_ready``/
+``device_get``/``np.asarray`` on results (enforced by the
+``serving-host-sync`` lint rule over this file). Materializing
+outputs — and therefore timing a request's completion — belongs to
+the consumer (``serving/api.py`` / the micro-batcher), which keeps
+dispatches pipelined exactly as the trainer pipelines train steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+from perceiver_tpu.serving.graphs import ServeGraph, build_serve_graph
+from perceiver_tpu.serving.metrics import MetricsRegistry
+
+# occupancy/waste are fractions in [0, 1] — linear buckets, not the
+# latency defaults
+_RATIO_BUCKETS = tuple(i / 10 for i in range(1, 11))
+
+
+class RequestTooLarge(ValueError):
+    """Request exceeds every configured bucket on some axis."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResult:
+    """One dispatched bucket call, still on device.
+
+    ``outputs`` hold bucket-shaped device arrays; ``batch``/``length``
+    say which slice is real. Nothing here has synchronized — slicing
+    to host happens in ``serving.api.materialize``.
+    """
+
+    outputs: Dict[str, object]
+    batch: int
+    length: Optional[int]
+    bucket: Tuple[int, Optional[int]]
+
+
+class ServingEngine:
+    """Checkpoint-loaded, AOT-compiled, bucketed forward executor."""
+
+    def __init__(self, task=None, params=None, *,
+                 graph: Optional[ServeGraph] = None,
+                 checkpoint: Optional[str] = None,
+                 batch_buckets: Sequence[int] = (1, 8, 32),
+                 seq_buckets: Optional[Sequence[int]] = (128, 512, 2048),
+                 policy: Policy = DEFAULT_POLICY,
+                 top_k: int = 3,
+                 metrics: Optional[MetricsRegistry] = None,
+                 allow_unlisted_buckets: bool = False,
+                 warmup: bool = True,
+                 seed: int = 0):
+        self.task = task
+        if graph is None:
+            if task is None:
+                raise ValueError("pass a task config or a ServeGraph")
+            graph = build_serve_graph(task, policy=policy, top_k=top_k)
+        self.graph: ServeGraph = graph
+        self.policy = policy
+        self.batch_buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
+        if self.batch_buckets and self.batch_buckets[0] < 1:
+            raise ValueError(f"invalid batch_buckets {batch_buckets!r}")
+        if not self.batch_buckets and not allow_unlisted_buckets:
+            raise ValueError(
+                "empty batch_buckets requires allow_unlisted_buckets "
+                "(exact-shape lazy compiles)")
+        if self.graph.seq_bucketable:
+            if seq_buckets:
+                self.seq_buckets = tuple(sorted(set(int(s)
+                                                    for s in seq_buckets)))
+                too_big = [s for s in self.seq_buckets
+                           if s > self.graph.max_seq_len]
+                if too_big:
+                    raise ValueError(
+                        f"seq_buckets {too_big} exceed the model's "
+                        f"max_seq_len {self.graph.max_seq_len}")
+            elif allow_unlisted_buckets:
+                self.seq_buckets = ()
+            else:
+                raise ValueError(
+                    f"task kind {self.graph.kind!r} buckets over the "
+                    "sequence axis; pass seq_buckets")
+        else:
+            self.seq_buckets = (None,)
+        self.allow_unlisted_buckets = allow_unlisted_buckets
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._init_metrics()
+
+        if params is None and checkpoint is not None:
+            from perceiver_tpu.training.checkpoint import restore_params
+            params = restore_params(checkpoint,
+                                    template=self.graph.init_params(seed))
+        elif params is None:
+            # fresh-init weights: load tests and offline benches; a
+            # production engine passes params or checkpoint
+            params = self.graph.init_params(seed)
+        import jax
+        self._params_src = params
+        self._params = jax.device_put(params)
+        self._exe = {}
+        self._exe_lock = threading.Lock()
+        if warmup:
+            self.warmup()
+
+    @classmethod
+    def from_graph(cls, graph: ServeGraph, params, *,
+                   batch_buckets: Sequence[int] = (),
+                   seq_buckets: Sequence[int] = (),
+                   policy: Policy = DEFAULT_POLICY,
+                   metrics: Optional[MetricsRegistry] = None,
+                   warmup: bool = False,
+                   allow_unlisted_buckets: bool = True) -> "ServingEngine":
+        """Engine over a prebuilt serve graph + live params — the
+        compat path for callers holding a model instead of a task
+        config. Defaults to exact-shape lazy buckets: the first call
+        at a new shape compiles once, repeats are cache hits."""
+        return cls(None, params, graph=graph,
+                   batch_buckets=batch_buckets, seq_buckets=seq_buckets,
+                   policy=policy, metrics=metrics, warmup=warmup,
+                   allow_unlisted_buckets=allow_unlisted_buckets)
+
+    # -- metrics ----------------------------------------------------------
+
+    def _init_metrics(self):
+        m = self.metrics
+        self._m_dispatch = m.counter(
+            "serving_bucket_dispatch_total",
+            "dispatches per (batch, seq) bucket")
+        self._m_compile = m.counter(
+            "serving_compile_total",
+            "AOT bucket compiles, by phase (warmup|lazy)")
+        self._m_hits = m.counter(
+            "serving_compile_cache_hits_total",
+            "dispatches served by an already-compiled bucket")
+        self._m_occupancy = m.histogram(
+            "serving_batch_occupancy",
+            "real rows / bucket batch per dispatch",
+            buckets=_RATIO_BUCKETS)
+        self._m_waste = m.histogram(
+            "serving_padding_waste_fraction",
+            "padded elements / bucket elements per dispatch",
+            buckets=_RATIO_BUCKETS)
+        self._m_buckets = m.gauge(
+            "serving_compiled_buckets", "compiled bucket executables")
+
+    # -- compilation ------------------------------------------------------
+
+    @property
+    def buckets(self) -> Tuple[Tuple[int, Optional[int]], ...]:
+        """The configured warmup bucket grid."""
+        return tuple((b, s) for s in self.seq_buckets
+                     for b in self.batch_buckets)
+
+    @property
+    def compiled_buckets(self) -> Tuple[Tuple[int, Optional[int]], ...]:
+        with self._exe_lock:
+            return tuple(sorted(self._exe,
+                                key=lambda k: (k[0], k[1] or 0)))
+
+    @property
+    def compile_count(self) -> int:
+        return int(self._m_compile.value)
+
+    def warmup(self) -> None:
+        """AOT-compile every configured bucket. After this returns, any
+        request that fits a bucket dispatches with zero XLA compiles."""
+        for bucket in self.buckets:
+            self._ensure_executable(bucket, phase="warmup")
+
+    def _input_structs(self, bucket):
+        import jax
+        b, s = bucket
+        return tuple(
+            jax.ShapeDtypeStruct(spec.shape(b, s), spec.dtype)
+            for spec in self.graph.inputs)
+
+    def _ensure_executable(self, bucket, phase: str = "lazy"):
+        with self._exe_lock:
+            exe = self._exe.get(bucket)
+        if exe is not None:
+            return exe
+        import jax
+        jitted = jax.jit(self.graph.fn,
+                         donate_argnums=self.graph.donate_argnums)
+        lowered = jitted.lower(self._params, *self._input_structs(bucket))
+        exe = lowered.compile()
+        with self._exe_lock:
+            # a concurrent compile of the same bucket may have won —
+            # keep the first, count only one executable
+            if bucket not in self._exe:
+                self._exe[bucket] = exe
+                self._m_compile.labels(phase=phase).inc()
+                self._m_buckets.set(len(self._exe))
+            exe = self._exe[bucket]
+        return exe
+
+    # -- params -----------------------------------------------------------
+
+    def update_params(self, params) -> None:
+        """Swap device-resident weights without recompiling: shapes and
+        dtypes must match the compiled executables' signature (weight
+        refresh, not architecture change)."""
+        import jax
+
+        if params is self._params_src:
+            return  # same host object — already resident
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self._params)
+        if new_def != old_def or any(
+                n.shape != o.shape or n.dtype != o.dtype
+                for n, o in zip(new_leaves, old_leaves)):
+            raise ValueError(
+                "update_params requires the same pytree structure, "
+                "shapes, and dtypes as the params the engine compiled "
+                "against — rebuild the engine for a new architecture")
+        self._params = jax.device_put(params)
+
+    # -- dispatch ---------------------------------------------------------
+
+    def bucket_for(self, batch: int, length: Optional[int] = None
+                   ) -> Tuple[int, Optional[int]]:
+        """Smallest configured bucket fitting (batch, length)."""
+        b = next((x for x in self.batch_buckets if x >= batch), None)
+        if self.graph.seq_bucketable:
+            if length is None:
+                raise ValueError("sequence-bucketed task needs a length")
+            s = next((x for x in self.seq_buckets if x >= length), None)
+        else:
+            s = None
+        if b is None or (self.graph.seq_bucketable and s is None):
+            if not self.allow_unlisted_buckets:
+                raise RequestTooLarge(
+                    f"request (batch={batch}, length={length}) exceeds "
+                    f"buckets batch≤{self.batch_buckets[-1]}"
+                    + (f", seq≤{self.seq_buckets[-1]}"
+                       if self.graph.seq_bucketable else ""))
+            b = b if b is not None else batch
+            if self.graph.seq_bucketable and s is None:
+                if length > self.graph.max_seq_len:
+                    raise RequestTooLarge(
+                        f"length {length} exceeds the model's "
+                        f"max_seq_len {self.graph.max_seq_len}")
+                s = length
+        return (b, s)
+
+    def _pad_to_bucket(self, arrays: dict, bucket) -> tuple:
+        b, s = bucket
+        padded = []
+        for spec in self.graph.inputs:
+            arr = arrays[spec.name]
+            shape = spec.shape(b, s)
+            if arr.shape == shape:
+                padded.append(arr)
+                continue
+            out = np.full(shape, spec.pad_value, dtype=np.dtype(spec.dtype))
+            out[tuple(slice(0, d) for d in arr.shape)] = arr
+            padded.append(out)
+        return tuple(padded)
+
+    def dispatch(self, arrays: Dict[str, np.ndarray]) -> ServeResult:
+        """Run one bucketed forward. ``arrays`` maps the graph's input
+        names to HOST arrays (rows ≤ the largest batch bucket). Returns
+        device-resident outputs; nothing in here blocks on the device.
+        """
+        expect = {spec.name for spec in self.graph.inputs}
+        if set(arrays) != expect:
+            raise ValueError(
+                f"dispatch inputs {sorted(arrays)} != expected "
+                f"{sorted(expect)}")
+        first = arrays[self.graph.inputs[0].name]
+        n = first.shape[0]
+        if n < 1:
+            raise ValueError("empty request batch")
+        length = first.shape[1] if self.graph.seq_bucketable else None
+        for spec in self.graph.inputs:
+            want = spec.shape(n, length)
+            if tuple(arrays[spec.name].shape) != want:
+                raise ValueError(
+                    f"input {spec.name!r} shape "
+                    f"{tuple(arrays[spec.name].shape)} != {want}")
+        bucket = self.bucket_for(n, length)
+        with self._exe_lock:
+            known = bucket in self._exe
+        if known:
+            self._m_hits.inc()
+        exe = self._ensure_executable(bucket)
+        outputs = exe(self._params, *self._pad_to_bucket(arrays, bucket))
+
+        bname = f"b{bucket[0]}" + (f"_s{bucket[1]}" if bucket[1] else "")
+        self._m_dispatch.labels(bucket=bname).inc()
+        self._m_occupancy.observe(n / bucket[0])
+        if self.graph.seq_bucketable:
+            waste = 1.0 - (n * length) / (bucket[0] * bucket[1])
+        else:
+            waste = 1.0 - n / bucket[0]
+        self._m_waste.observe(waste)
+        return ServeResult(outputs=outputs, batch=n, length=length,
+                           bucket=bucket)

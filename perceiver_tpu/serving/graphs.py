@@ -1,0 +1,213 @@
+"""Serve-graph builders: one pure forward function per task.
+
+This module is the single source of truth for what a *served* forward
+pass computes — the engine AOT-compiles these functions per shape
+bucket (``serving/engine.py``) and the static-analysis subsystem
+lowers the very same functions as canonical serving targets
+(``analysis/targets.py``), so the graph the gates certify is the graph
+production dispatches. It therefore must not import from
+``perceiver_tpu.analysis`` or ``perceiver_tpu.serving.engine``.
+
+Design rules (mirroring the train-step targets):
+
+- **bf16 policy end to end** — every matmul in the serve graph runs on
+  bf16 operands (``dtype_policy`` pins the MLM serve graph's
+  FLOP-weighted bf16 fraction at 1.0); statistics (softmax, top-k
+  scores) are computed in fp32.
+- **Device-side post-processing** — top-k, argmax, and mask filling
+  happen inside the compiled graph, so the host round trip carries
+  kilobytes (predictions), not the (B, L, V) logits tensor.
+- **Donation where it aliases** — the MLM graph returns ``filled_ids``
+  (same shape/dtype as ``input_ids``) and ``is_masked`` (same as
+  ``pad_mask``), so both request buffers are donated and re-used by
+  XLA in place. Graphs with no alias-compatible output donate nothing
+  (a donated-but-unaliasable buffer is a ``donation_check`` violation,
+  not an optimization).
+- **No host callbacks** — serve graphs must stay dispatchable on the
+  axon runtime, which rejects host callbacks; ``transfer_guard`` runs
+  over every registered serving target with an empty allowlist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+from perceiver_tpu.tokenizer import MASK_TOKEN_ID, PAD_TOKEN_ID
+
+
+@dataclasses.dataclass(frozen=True)
+class InputSpec:
+    """One request-tensor slot of a serve graph.
+
+    ``shape(batch, seq)`` yields the bucket shape (``seq`` is ignored
+    by fixed-shape tasks); ``pad_value`` is what bucket padding fills
+    with — chosen so padded positions are inert (PAD tokens, masked-out
+    key positions, zero pixels the segmentation pad-mask drops).
+    """
+
+    name: str
+    dtype: object
+    shape: Callable[[int, int], Tuple[int, ...]]
+    pad_value: object
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeGraph:
+    """A task's serve computation plus everything needed to bucket it.
+
+    ``fn(params, *inputs)`` returns a dict of device arrays whose
+    leading axis is the bucket batch. ``donate_argnums`` index into
+    ``fn``'s positional args (params is argnum 0 and never donated —
+    it stays device-resident across requests)."""
+
+    kind: str
+    model: object
+    fn: Callable
+    inputs: Tuple[InputSpec, ...]
+    output_names: Tuple[str, ...]
+    donate_argnums: Tuple[int, ...]
+    # text graphs bucket over (batch, seq); image graphs only batch
+    seq_bucketable: bool
+    # largest servable sequence (model position table size); None for
+    # fixed-shape tasks
+    max_seq_len: Optional[int] = None
+    # outputs whose axis 1 is the (bucket-padded) sequence axis —
+    # ``serving.api.materialize`` slices them back to request length
+    seq_axis_outputs: Tuple[str, ...] = ()
+
+    def init_params(self, seed: int = 0):
+        return self.model.init(jax.random.key(seed))
+
+
+def mlm_serve_graph(model, *, policy: Policy = DEFAULT_POLICY,
+                    top_k: int = 3,
+                    max_seq_len: Optional[int] = None) -> ServeGraph:
+    """MLM fill-mask graph from a built ``PerceiverMLM`` — the entry
+    the ``utils/predict.py`` compat wrapper uses (it holds a model +
+    params, not a task config)."""
+    if max_seq_len is None:
+        # TextOutputAdapter: output_shape = (max_seq_len, channels)
+        max_seq_len = model.decoder.output_adapter.output_shape[0]
+
+    def fn(params, input_ids, pad_mask):
+        logits, _ = model.apply(params, input_ids, pad_mask,
+                                masking=False, policy=policy)
+        # scores in fp32 (norm-dtype convention); the vocab projection
+        # itself ran in bf16 inside the adapter
+        scores, topk_ids = jax.lax.top_k(
+            logits.astype(jnp.float32), top_k)
+        topk_ids = topk_ids.astype(input_ids.dtype)
+        is_masked = input_ids == MASK_TOKEN_ID
+        filled_ids = jnp.where(is_masked, topk_ids[..., 0], input_ids)
+        return {"filled_ids": filled_ids, "topk_ids": topk_ids,
+                "topk_scores": scores, "is_masked": is_masked}
+
+    return ServeGraph(
+        kind="mlm", model=model, fn=fn,
+        inputs=(
+            InputSpec("input_ids", jnp.int32, lambda b, s: (b, s),
+                      PAD_TOKEN_ID),
+            InputSpec("pad_mask", jnp.bool_, lambda b, s: (b, s), True),
+        ),
+        output_names=("filled_ids", "topk_ids", "topk_scores",
+                      "is_masked"),
+        seq_axis_outputs=("filled_ids", "topk_ids", "topk_scores",
+                          "is_masked"),
+        # input_ids → filled_ids and pad_mask → is_masked alias
+        # exactly (shape and dtype), so both request buffers donate
+        donate_argnums=(1, 2),
+        seq_bucketable=True, max_seq_len=max_seq_len)
+
+
+def _mlm_graph(task, policy: Policy, top_k: int) -> ServeGraph:
+    return mlm_serve_graph(task.build(), policy=policy, top_k=top_k,
+                           max_seq_len=task.max_seq_len)
+
+
+def _classifier_fn(model, policy: Policy):
+    def fn(params, *inputs):
+        logits = model.apply(params, *inputs, policy=policy)
+        logits = logits.astype(jnp.float32)
+        return {"logits": logits,
+                "probs": jax.nn.softmax(logits, axis=-1),
+                "label": jnp.argmax(logits, axis=-1).astype(jnp.int32)}
+    return fn
+
+
+def _text_clf_graph(task, policy: Policy) -> ServeGraph:
+    model = task.build()
+    return ServeGraph(
+        kind="text_clf", model=model, fn=_classifier_fn(model, policy),
+        inputs=(
+            InputSpec("input_ids", jnp.int32, lambda b, s: (b, s),
+                      PAD_TOKEN_ID),
+            InputSpec("pad_mask", jnp.bool_, lambda b, s: (b, s), True),
+        ),
+        output_names=("logits", "probs", "label"),
+        # (B, L) int32/bool cannot alias the (B, C)/(B,) outputs —
+        # donating them would only trip donation_check
+        donate_argnums=(),
+        seq_bucketable=True, max_seq_len=task.max_seq_len)
+
+
+def _img_clf_graph(task, policy: Policy) -> ServeGraph:
+    model = task.build()
+    shape = tuple(task.image_shape)
+    return ServeGraph(
+        kind="img_clf", model=model, fn=_classifier_fn(model, policy),
+        inputs=(InputSpec("image", jnp.float32,
+                          lambda b, s: (b, *shape), 0.0),),
+        output_names=("logits", "probs", "label"),
+        donate_argnums=(), seq_bucketable=False)
+
+
+def _seg_graph(task, policy: Policy) -> ServeGraph:
+    model = task.build()
+    h, w, _ = task.image_shape
+
+    def fn(params, image):
+        logits = task.forward(model, params, image, policy=policy)
+        logits = logits.astype(jnp.float32)
+        b = image.shape[0]
+        classes = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        conf = jnp.max(jax.nn.softmax(logits, axis=-1), axis=-1)
+        return {"classes": classes.reshape(b, h, w),
+                "confidence": conf.reshape(b, h, w)}
+
+    return ServeGraph(
+        kind="seg", model=model, fn=fn,
+        inputs=(InputSpec("image", jnp.float32,
+                          lambda b, s: (b, h, w), 0.0),),
+        output_names=("classes", "confidence"),
+        donate_argnums=(), seq_bucketable=False)
+
+
+def build_serve_graph(task, *, policy: Policy = DEFAULT_POLICY,
+                      top_k: int = 3) -> ServeGraph:
+    """Serve graph for a task config (dispatch on the task type)."""
+    # imported here so graphs stays importable without the full task
+    # registry at module-import time
+    from perceiver_tpu.tasks import (
+        ImageClassifierTask,
+        MaskedLanguageModelTask,
+        SegmentationTask,
+        TextClassifierTask,
+    )
+
+    if isinstance(task, MaskedLanguageModelTask):
+        return _mlm_graph(task, policy, top_k)
+    if isinstance(task, TextClassifierTask):
+        return _text_clf_graph(task, policy)
+    if isinstance(task, SegmentationTask):
+        return _seg_graph(task, policy)
+    if isinstance(task, ImageClassifierTask):
+        return _img_clf_graph(task, policy)
+    raise TypeError(
+        f"no serve graph for task type {type(task).__name__}; supported: "
+        "MaskedLanguageModelTask, TextClassifierTask, "
+        "ImageClassifierTask, SegmentationTask")

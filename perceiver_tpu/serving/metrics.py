@@ -1,0 +1,251 @@
+"""Serving metrics: counters, gauges, latency histograms, Prometheus
+text exposition.
+
+Deliberately dependency-free (no prometheus_client in the image): the
+three metric kinds the serving plane needs are small, and owning them
+keeps the hot path allocation-free — ``observe``/``inc`` are a lock,
+two adds, and a ring-buffer store.
+
+Quantiles: Prometheus histograms only expose cumulative bucket counts
+(quantiles are computed server-side), but the offline load generator
+and the tests need exact-ish tail latencies locally — so ``Histogram``
+additionally keeps a bounded reservoir (last ``reservoir`` samples)
+and computes p50/p95/p99 from it. The text exposition stays pure
+Prometheus (``_bucket``/``_sum``/``_count``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+# seconds; spans 100 µs → 10 s, roughly log-spaced (serving latencies
+# on CPU tests sit in the ms range, on chips in the 100 µs range)
+DEFAULT_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    out = repr(float(v))
+    return out[:-2] if out.endswith(".0") else out
+
+
+class Counter:
+    """Monotonic counter family; ``labels(...)`` returns a child whose
+    increments are tracked per label set."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def labels(self, **labels) -> "_CounterChild":
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values.setdefault(key, 0.0)
+        return _CounterChild(self, key)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._inc((), amount)
+
+    def _inc(self, key, amount: float) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def value_of(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def items(self):
+        """Snapshot of (labels dict, value) per label set."""
+        with self._lock:
+            return [(dict(k), v) for k, v in sorted(self._values.items())]
+
+    def collect(self) -> Iterable[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            yield f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}"
+
+
+class _CounterChild:
+    __slots__ = ("_parent", "_key")
+
+    def __init__(self, parent: Counter, key):
+        self._parent = parent
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._parent._inc(self._key, amount)
+
+
+class Gauge:
+    """Set-to-current-value metric (queue depth, bucket count)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def collect(self) -> Iterable[str]:
+        yield f"{self.name} {_fmt_value(self.value)}"
+
+
+class Histogram:
+    """Cumulative-bucket histogram + bounded reservoir for quantiles."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS,
+                 reservoir: int = 8192):
+        if tuple(buckets) != tuple(sorted(buckets)):
+            raise ValueError("histogram buckets must be sorted")
+        self.name = name
+        self.help = help
+        self.buckets = tuple(buckets)
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+        self._reservoir_cap = reservoir
+        self._reservoir = [0.0] * reservoir
+        self._reservoir_n = 0  # total observed (ring write index)
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+            self._reservoir[self._reservoir_n % self._reservoir_cap] = value
+            self._reservoir_n += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Exact quantile over the retained reservoir (the last
+        ``reservoir`` observations), or None before any sample."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            n = min(self._reservoir_n, self._reservoir_cap)
+            if n == 0:
+                return None
+            window = sorted(self._reservoir[:n])
+        return window[min(int(q * n), n - 1)]
+
+    def collect(self) -> Iterable[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total, acc = self._count, self._sum
+        cum = 0
+        for bound, c in zip(self.buckets + (math.inf,), counts):
+            cum += c
+            yield (f"{self.name}_bucket{{le=\"{_fmt_value(bound)}\"}} "
+                   f"{cum}")
+        yield f"{self.name}_sum {_fmt_value(acc)}"
+        yield f"{self.name}_count {total}"
+
+
+class MetricsRegistry:
+    """Namespace of metrics with Prometheus text exposition.
+
+    One registry per serving engine (tests build throwaways); metric
+    constructors are idempotent by name so the engine, batcher, and
+    api front-ends can all resolve the same metric.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}")
+                return existing
+            metric = cls(name, help, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS,
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines = []
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"

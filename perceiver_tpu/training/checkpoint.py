@@ -124,12 +124,23 @@ def _partial_restore(path: str, item: dict) -> dict:
     """Typed partial restore of selected subtrees from a checkpoint
     step's ``default`` item dir (a save may hold more than the caller
     wants — or can type — e.g. an opt_state from a different optimizer
-    config)."""
+    config).
+
+    Orbax's native ``partial_restore`` kwarg only exists from the 0.9
+    line; this image ships 0.7, where the supported spelling of "drop
+    checkpoint subtrees absent from my template" is an empty
+    ``transforms`` dict (fallback-to-item semantics). Try the modern
+    kwarg first so an orbax upgrade keeps working, then degrade."""
     with ocp.PyTreeCheckpointer() as ckptr:
-        return ckptr.restore(path, args=ocp.args.PyTreeRestore(
-            item=item,
-            restore_args=ocp.checkpoint_utils.construct_restore_args(item),
-            partial_restore=True))
+        restore_args = ocp.checkpoint_utils.construct_restore_args(item)
+        try:
+            args = ocp.args.PyTreeRestore(
+                item=item, restore_args=restore_args,
+                partial_restore=True)
+        except TypeError:
+            args = ocp.args.PyTreeRestore(
+                item=item, restore_args=restore_args, transforms={})
+        return ckptr.restore(path, args=args)
 
 
 def restore_params(path: str, template: Any = None) -> Any:

@@ -491,14 +491,15 @@ class Trainer:
                                   monitor=cfg.checkpoint_monitor)
             try:
                 restored = hook.restore_latest(state)
-            except ValueError as e:
-                # orbax raises ValueError on tree/shape mismatch —
-                # typically the checkpoint's optimizer state no longer
-                # matching the current optimizer/scheduler config
-                # (e.g. the schedule changed between runs); params +
-                # rng + step are still config-agnostic and worth
-                # resuming from. Other failures (I/O, corruption)
-                # propagate.
+            except (ValueError, KeyError) as e:
+                # orbax raises ValueError (or, on the 0.7 line's
+                # flat-dict template matching, KeyError) on tree/shape
+                # mismatch — typically the checkpoint's optimizer
+                # state no longer matching the current optimizer/
+                # scheduler config (e.g. the schedule changed between
+                # runs); params + rng + step are still config-agnostic
+                # and worth resuming from. Other failures (I/O,
+                # corruption) propagate.
                 import warnings
 
                 warnings.warn(

@@ -1,21 +1,29 @@
-"""Masked-sample prediction — the framework's inference entry.
+"""Masked-sample prediction — compat wrapper over the serving engine.
 
 Parity target: reference ``perceiver/utils.py:22-43`` / SURVEY §3.5:
 encode raw strings (containing ``[MASK]``) with the data collator, run
 the MLM with ``masking=False``, take top-k vocab logits at each masked
 position, substitute each of the k predictions, and decode back to k
 complete strings per sample.
+
+Historically this helper re-created a lambda per call — a fresh jit
+cache key, i.e. one full XLA recompile *per prediction request* — and
+pulled the whole (B, L, V) logits tensor to the host. It now routes
+through ``perceiver_tpu.serving``: the serve graph (top-k and mask
+filling on device) is AOT-compiled once per shape and cached per model
+config, so a second call at the same shapes performs zero new
+compiles, and weight refreshes (the trainer calls this every val
+epoch with updated params) swap device buffers without recompiling.
+The signature and return value are unchanged.
 """
 
 from __future__ import annotations
 
 from typing import Callable, List
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from perceiver_tpu.tokenizer import MASK_TOKEN_ID
+from perceiver_tpu.serving.api import (  # noqa: F401 — public re-export
+    predict_masked_samples as _serve_predict_masked_samples,
+)
 
 
 def predict_masked_samples(masked_samples: List[str],
@@ -25,26 +33,6 @@ def predict_masked_samples(masked_samples: List[str],
                            params,
                            num_predictions: int = 3,
                            policy=None) -> List[List[str]]:
-    ids, pad_mask = encode_fn(masked_samples)
-    ids = jnp.asarray(ids)
-    pad_mask = jnp.asarray(pad_mask)
-
-    kwargs = {} if policy is None else {"policy": policy}
-    logits, _ = jax.jit(
-        lambda p, x, m: model.apply(p, x, m, masking=False, **kwargs)
-    )(params, ids, pad_mask)
-
-    ids = np.asarray(ids)
-    _, top = jax.lax.top_k(logits.astype(jnp.float32), num_predictions)
-    top = np.asarray(top)
-
-    results: List[List[str]] = []
-    for b in range(ids.shape[0]):
-        mask_pos = np.nonzero(ids[b] == MASK_TOKEN_ID)[0]
-        preds = []
-        for k in range(num_predictions):
-            filled = ids[b].copy()
-            filled[mask_pos] = top[b, mask_pos, k]
-            preds.append(tokenizer.decode(filled.tolist()))
-        results.append(preds)
-    return results
+    return _serve_predict_masked_samples(
+        masked_samples, encode_fn, tokenizer, model, params,
+        num_predictions=num_predictions, policy=policy)

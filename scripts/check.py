@@ -49,8 +49,14 @@ def main() -> int:
                          "hbm_budgets.json manifest (only after an "
                          "INTENTIONAL traffic change — commit the "
                          "manifest diff with the justification)")
+    ap.add_argument("--pin-missing-hbm", action="store_true",
+                    help="measure and pin budgets ONLY for canonical "
+                         "targets absent from hbm_budgets.json (the "
+                         "new-target path — existing pins are copied "
+                         "through untouched, never re-baselined)")
     args = ap.parse_args()
-    if not (args.all or args.lint or args.graph or args.rebaseline_hbm):
+    if not (args.all or args.lint or args.graph or args.rebaseline_hbm
+            or args.pin_missing_hbm):
         args.all = True
 
     from perceiver_tpu.analysis import (
@@ -64,10 +70,21 @@ def main() -> int:
         write_hbm_budgets,
     )
 
-    if args.rebaseline_hbm:
+    if args.rebaseline_hbm or args.pin_missing_hbm:
         import datetime
+
+        from perceiver_tpu.analysis import load_hbm_budgets
+
+        keep = {}
+        targets = CANONICAL_TARGETS
+        if args.pin_missing_hbm and not args.rebaseline_hbm:
+            keep = load_hbm_budgets()
+            targets = [t for t in CANONICAL_TARGETS if t.name not in keep]
+            if not targets:
+                print("[check] every canonical target already has a "
+                      "pinned budget — nothing to do", file=sys.stderr)
         measured = {}
-        for target in CANONICAL_TARGETS:
+        for target in targets:
             print(f"[check] lowering {target.name} ...", file=sys.stderr)
             lowered = lower_target(target)
             if lowered.bytes_accessed is None:
@@ -78,11 +95,12 @@ def main() -> int:
             print(f"[check] {target.name}: "
                   f"{lowered.bytes_accessed / 1e9:.2f} GB",
                   file=sys.stderr)
-        write_hbm_budgets(
-            measured, note=str(datetime.date.today()))
-        print("[check] hbm_budgets.json rewritten — commit it with "
-              "the change that justified the re-baseline",
-              file=sys.stderr)
+        if measured:
+            write_hbm_budgets(
+                measured, note=str(datetime.date.today()), keep=keep)
+            print("[check] hbm_budgets.json rewritten — commit it with "
+                  "the change that justified the re-baseline",
+                  file=sys.stderr)
         if not (args.all or args.lint or args.graph):
             return 0
 

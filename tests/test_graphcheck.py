@@ -22,6 +22,7 @@ import pytest
 from perceiver_tpu.analysis import (
     CANONICAL_TARGETS,
     DtypeAllow,
+    SERVING_TARGETS,
     StepTarget,
     TransferAllow,
     donation_check,
@@ -272,6 +273,21 @@ def test_hbm_budget_manifest_roundtrip(tmp_path):
     assert {t.name for t in CANONICAL_TARGETS} <= set(pinned)
 
 
+def test_hbm_budget_write_keeps_existing_pins(tmp_path):
+    """The --pin-missing-hbm merge path: existing entries are copied
+    through byte-identically, only the new target gets pinned — adding
+    a serving target must never silently re-baseline the train pins."""
+    path = str(tmp_path / "budgets.json")
+    write_hbm_budgets({"old": 100.0}, path=path, note="r6")
+    before = load_hbm_budgets(path)
+    write_hbm_budgets({"new": 50.0}, path=path, note="r7", keep=before)
+    after = load_hbm_budgets(path)
+    assert after["old"] == before["old"]  # untouched, note still "r6"
+    assert after["old"]["pinned"] == "r6"
+    assert after["new"] == {"budget_bytes": 52, "pinned_bytes": 50,
+                            "pinned": "r7"}
+
+
 def test_hbm_budget_seeded_violation_through_runner(
         tmp_path, monkeypatch, lowered_target_cache):
     """End-to-end: shrink the checked-in budget for a real canonical
@@ -305,6 +321,75 @@ def test_headline_hbm_bytes_pinned_below_baseline():
     + attention recompute + packed masked-position decode win)."""
     pinned = load_hbm_budgets()["mlm_b512_c64_packed"]
     assert pinned["budget_bytes"] < 0.75 * 133.0e9
+
+
+# --- serving targets (ISSUE 3) ----------------------------------------------
+
+
+def _tiny_serve_target(name="tiny_serve", batch=2, seq=16):
+    def build():
+        import numpy as np
+
+        task = _tiny_mlm()
+        rng = np.random.default_rng(0)
+        data = {
+            "input_ids": jnp.asarray(
+                rng.integers(3, 110, (batch, seq)), jnp.int32),
+            "pad_mask": jnp.zeros((batch, seq), bool),
+        }
+        return task, data
+
+    return StepTarget(name=name, build=build, kind="serve")
+
+
+def test_serving_targets_registered_and_budgeted():
+    """Every serving target rides CANONICAL_TARGETS (so check.py --all
+    gates it) and has a pinned hbm budget — an unbudgeted serve graph
+    would silently opt out of the traffic gate."""
+    names = {t.name for t in SERVING_TARGETS}
+    assert names == {"serve_mlm_b32_s512", "serve_text_clf_b32_s512",
+                     "serve_img_clf_b32", "serve_seg_512x512_b1"}
+    assert names <= {t.name for t in CANONICAL_TARGETS}
+    assert all(t.kind == "serve" for t in SERVING_TARGETS)
+    assert names <= set(load_hbm_budgets())
+    # the fast tier keeps all serve targets (forward-only = cheap)
+    from perceiver_tpu.analysis import FAST_TARGETS
+    assert names <= {t.name for t in FAST_TARGETS}
+
+
+def test_serve_step_donation_contract_lowered():
+    """The MLM serve graph donates exactly its request buffers, and
+    lowering actually aliases them onto outputs (filled_ids/is_masked
+    share shape+dtype by construction) — donation_check must pass with
+    the serve step's own expected count."""
+    from perceiver_tpu.analysis.targets import lower_target
+
+    lowered = lower_target(_tiny_serve_target())
+    assert lowered.expected_donated == 2  # input_ids + pad_mask
+    assert not donation_check(lowered.text, where="tiny_serve",
+                              expected_donated=lowered.expected_donated)
+    # and the graph is callback-free + all-bf16 on the dot FLOPs
+    assert not transfer_guard(lowered.text, where="tiny_serve")
+    violations, summary = dtype_policy(lowered.text, where="tiny_serve",
+                                       require_full_bf16=True)
+    assert not violations
+    assert summary["bf16_flop_fraction"] == 1.0
+
+
+def test_serve_target_recompile_closure():
+    """Independent rebuilds of a serve target lower byte-identically —
+    the property that keeps the engine's AOT bucket set closed (any
+    drift would be a per-restart recompile on the chip)."""
+    violations, fp = recompile_budget(_tiny_serve_target())
+    assert not violations
+    assert fp
+
+
+def test_serve_headline_is_mlm_bf16():
+    serve_mlm = next(t for t in SERVING_TARGETS
+                     if t.name == "serve_mlm_b32_s512")
+    assert serve_mlm.headline
+    assert serve_mlm.transfer_allow == ()  # no callbacks in serve graphs
 
 
 # --- lint rules -------------------------------------------------------------
@@ -450,6 +535,62 @@ def test_lint_accepts_not_in_domain_validation():
 def test_lint_suppression_marker():
     src = _JIT_ITEM.replace(".item()", ".item()  # graphcheck: ignore")
     assert not _checks(src)
+
+
+_ENGINE_SYNC = """
+import numpy as np
+import jax
+
+def dispatch(self, arrays):
+    out = self._exe[bucket](self._params, *arrays)
+    depth = out["count"].item()
+    host = np.asarray(out["filled_ids"])
+    jax.block_until_ready(out)
+    got = jax.device_get(out)
+    return host.tolist()
+"""
+
+_ENGINE_CLEAN = """
+import numpy as np
+
+def _pad_to_bucket(self, arrays, bucket):
+    out = np.full((4, 16), 0, dtype=np.int32)
+    out[: arrays.shape[0]] = arrays
+    return out
+
+def dispatch(self, arrays):
+    return self._exe[bucket](self._params, self._pad_to_bucket(arrays))
+"""
+
+_ENGINE_PATH = "perceiver_tpu/serving/engine.py"
+
+
+def test_lint_serving_host_sync_seeded():
+    """Every sync shape the rule exists for: .item, np.asarray,
+    block_until_ready, device_get, .tolist — all flagged, only inside
+    serving/engine.py."""
+    checks = _checks(_ENGINE_SYNC, _ENGINE_PATH)
+    assert checks.count("serving-host-sync") == 5
+    # identical source anywhere else is not the engine's contract
+    assert "serving-host-sync" not in _checks(_ENGINE_SYNC,
+                                              "perceiver_tpu/serving/api.py")
+
+
+def test_lint_serving_host_sync_allows_host_padding():
+    """np.full padding of HOST request arrays is the engine's job and
+    must not be flagged — only conversions that force a device sync."""
+    assert not _checks(_ENGINE_CLEAN, _ENGINE_PATH)
+
+
+def test_lint_serving_engine_file_is_clean():
+    """The real engine honors its own rule (the gate would fail the
+    merge otherwise, but pin it directly too)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rel = "perceiver_tpu/serving/engine.py"
+    with open(os.path.join(root, rel)) as f:
+        assert not lint_source(f.read(), rel), rel
 
 
 def test_lint_clean_on_fixed_tree_files():

@@ -1,0 +1,470 @@
+"""CPU end-to-end tests of the serving subsystem (ISSUE 3).
+
+The acceptance properties, each pinned here:
+
+- after engine warmup, a mixed-shape load (3 seq lengths × 2 batch
+  sizes) completes with ZERO new XLA compiles (jax.monitoring compile
+  events counted around the dispatches);
+- engine outputs are bitwise-identical to a fresh jit of the same
+  serve graph AND consistent with direct ``model.apply``;
+- requests land in the smallest fitting bucket and the dispatch /
+  occupancy / padding metrics record exactly the work performed;
+- the micro-batcher coalesces concurrent requests, sheds on a full
+  queue and on expired deadlines with typed ``Overloaded`` results;
+- ``predict_masked_samples`` (the rewritten ``utils/predict.py``)
+  performs zero new compiles on a second call at the same shapes —
+  the regression the old re-jitting helper failed.
+"""
+
+import contextlib
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_tpu.serving import (
+    MicroBatcher,
+    MLMServer,
+    Overloaded,
+    RequestTooLarge,
+    ServingEngine,
+    materialize,
+)
+from perceiver_tpu.serving.metrics import MetricsRegistry
+from perceiver_tpu.tasks import MaskedLanguageModelTask
+from perceiver_tpu.tokenizer import MASK_TOKEN_ID
+
+VOCAB = 110
+
+
+def tiny_mlm_task(**overrides):
+    kwargs = dict(
+        vocab_size=VOCAB, max_seq_len=32, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+    kwargs.update(overrides)
+    return MaskedLanguageModelTask(**kwargs)
+
+
+@contextlib.contextmanager
+def compile_events():
+    """Collect XLA compile events (jax.monitoring) inside the block."""
+    from jax._src import monitoring as _monitoring
+
+    events = []
+
+    def listener(name, **kwargs):
+        if "compile" in name:
+            events.append(name)
+
+    jax.monitoring.register_event_listener(listener)
+    try:
+        yield events
+    finally:
+        _monitoring._unregister_event_listener_by_callback(listener)
+
+
+def request_arrays(batch, length, seed=0, mask_every=4):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(3, VOCAB, (batch, length)).astype(np.int32)
+    ids[:, ::mask_every] = MASK_TOKEN_ID
+    pad_mask = np.zeros((batch, length), bool)
+    return {"input_ids": ids, "pad_mask": pad_mask}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return ServingEngine(tiny_mlm_task(), batch_buckets=(1, 4),
+                         seq_buckets=(16, 32))
+
+
+class TestEngine:
+    def test_warmup_compiles_every_bucket(self, engine):
+        assert engine.compiled_buckets == ((1, 16), (1, 32), (4, 16),
+                                           (4, 32))
+        assert engine.compile_count == 4
+        assert engine.metrics.get(
+            "serving_compile_total").value_of(phase="warmup") == 4
+
+    def test_mixed_shape_load_zero_new_compiles(self, engine):
+        """≥3 seq lengths × ≥2 batch sizes post warmup: zero XLA
+        compiles (the acceptance criterion)."""
+        shapes = [(1, 7), (3, 7), (1, 16), (2, 23), (4, 32), (3, 12)]
+        with compile_events() as events:
+            for i, (b, length) in enumerate(shapes):
+                res = engine.dispatch(request_arrays(b, length, seed=i))
+                assert res.batch == b and res.length == length
+            # force materialization too — execution must not compile
+            materialize(res, engine.graph)
+        assert events == [], f"post-warmup dispatch compiled: {events}"
+        assert engine.compile_count == 4
+
+    def test_smallest_fitting_bucket_and_counters(self):
+        metrics = MetricsRegistry()
+        eng = ServingEngine(tiny_mlm_task(), batch_buckets=(1, 4),
+                            seq_buckets=(16, 32), metrics=metrics)
+        for b, length in [(1, 9), (2, 9), (4, 16), (1, 17), (3, 32)]:
+            assert eng.dispatch(request_arrays(b, length)).bucket == (
+                (1 if b == 1 else 4), (16 if length <= 16 else 32))
+        dispatch = metrics.get("serving_bucket_dispatch_total")
+        assert dispatch.value_of(bucket="b1_s16") == 1
+        assert dispatch.value_of(bucket="b4_s16") == 2
+        assert dispatch.value_of(bucket="b1_s32") == 1
+        assert dispatch.value_of(bucket="b4_s32") == 1
+        assert dispatch.value == 5
+        waste = metrics.get("serving_padding_waste_fraction")
+        assert waste.count == 5
+        # (1,9)→bucket(1,16): waste 1-9/16; (2,9)→(4,16): 1-18/64; ...
+        expect = [1 - 9 / 16, 1 - 18 / 64, 0.0, 1 - 17 / 32,
+                  1 - 96 / 128]
+        assert waste.sum == pytest.approx(sum(expect))
+        occ = metrics.get("serving_batch_occupancy")
+        assert occ.count == 5
+        assert occ.sum == pytest.approx(1 + 0.5 + 1 + 1 + 0.75)
+
+    def test_aot_executable_matches_fresh_jit_bitwise(self, engine):
+        arrays = request_arrays(3, 13, seed=42)
+        out = materialize(engine.dispatch(dict(arrays)), engine.graph)
+        bucket = engine.bucket_for(3, 13)
+        padded = engine._pad_to_bucket(arrays, bucket)
+        fresh = jax.jit(engine.graph.fn)(engine._params, *padded)
+        for name, got in out.items():
+            want = np.asarray(fresh[name])[:3, :13]
+            np.testing.assert_array_equal(got, want, err_msg=name)
+
+    def test_consistent_with_direct_model_apply(self, engine):
+        """Semantic parity: top-k over direct ``model.apply`` logits at
+        the same padded shapes reproduces the engine's predictions."""
+        arrays = request_arrays(2, 16, seed=7)
+        out = materialize(engine.dispatch(dict(arrays)), engine.graph)
+        model = engine.graph.model
+        logits, _ = jax.jit(
+            lambda p, i, m: model.apply(p, i, m, masking=False,
+                                        policy=engine.policy)
+        )(engine._params, arrays["input_ids"], arrays["pad_mask"])
+        scores, idx = jax.lax.top_k(logits.astype(jnp.float32), 3)
+        np.testing.assert_array_equal(out["topk_ids"], np.asarray(idx))
+        np.testing.assert_array_equal(out["topk_scores"],
+                                      np.asarray(scores))
+        filled = np.where(arrays["input_ids"] == MASK_TOKEN_ID,
+                          np.asarray(idx)[..., 0], arrays["input_ids"])
+        np.testing.assert_array_equal(out["filled_ids"], filled)
+
+    def test_dispatch_does_not_clobber_request_arrays(self, engine):
+        """The MLM graph donates its request buffers — donation must
+        consume the device COPY, never the caller's host arrays."""
+        arrays = request_arrays(2, 16, seed=3)
+        ids_before = arrays["input_ids"].copy()
+        engine.dispatch(arrays)
+        engine.dispatch(arrays)  # same host arrays again
+        np.testing.assert_array_equal(arrays["input_ids"], ids_before)
+
+    def test_request_too_large(self, engine):
+        with pytest.raises(RequestTooLarge):
+            engine.dispatch(request_arrays(5, 16))  # batch > 4
+        with pytest.raises(ValueError):
+            engine.dispatch({"input_ids": np.zeros((1, 4), np.int32)})
+
+    def test_seq_bucket_beyond_model_rejected(self):
+        with pytest.raises(ValueError, match="max_seq_len"):
+            ServingEngine(tiny_mlm_task(), batch_buckets=(1,),
+                          seq_buckets=(64,), warmup=False)
+
+    def test_update_params_refreshes_without_recompile(self):
+        eng = ServingEngine(tiny_mlm_task(), batch_buckets=(2,),
+                            seq_buckets=(16,))
+        arrays = request_arrays(2, 16, seed=5)
+        before = materialize(eng.dispatch(dict(arrays)), eng.graph)
+        new_params = eng.graph.init_params(seed=123)
+        with compile_events() as events:
+            eng.update_params(new_params)
+            after = materialize(eng.dispatch(dict(arrays)), eng.graph)
+        assert events == []
+        assert not np.array_equal(before["topk_scores"],
+                                  after["topk_scores"])
+        with pytest.raises(ValueError, match="same pytree structure"):
+            eng.update_params({"nope": np.zeros(3)})
+
+    def test_checkpoint_restore_roundtrip(self, tmp_path):
+        from perceiver_tpu.training.checkpoint import save_params
+
+        task = tiny_mlm_task()
+        params = task.build().init(jax.random.key(9))
+        save_params(str(tmp_path / "ck"), params)
+        eng = ServingEngine(task, checkpoint=str(tmp_path / "ck"),
+                            batch_buckets=(1,), seq_buckets=(16,))
+        ref = ServingEngine(task, params, batch_buckets=(1,),
+                            seq_buckets=(16,))
+        arrays = request_arrays(1, 16, seed=11)
+        out = materialize(eng.dispatch(dict(arrays)), eng.graph)
+        want = materialize(ref.dispatch(dict(arrays)), ref.graph)
+        for name in out:
+            np.testing.assert_array_equal(out[name], want[name], name)
+
+
+class TestMicroBatcher:
+    def test_coalesces_concurrent_requests(self):
+        seen_batches = []
+        done = threading.Event()
+
+        def runner(items):
+            seen_batches.append(len(items))
+            done.wait(0.2)  # hold the first batch so the rest queue up
+            return [x * 10 for x in items]
+
+        mb = MicroBatcher(runner, max_batch=4, max_delay_ms=50,
+                          max_depth=64)
+        try:
+            futs = [mb.submit(i) for i in range(9)]
+            done.set()
+            results = [f.result(timeout=10) for f in futs]
+            assert results == [i * 10 for i in range(9)]
+            assert sum(seen_batches) == 9
+            assert max(seen_batches) <= 4
+            assert len(seen_batches) >= 3
+            m = mb.metrics
+            assert m.get("serving_requests_total").value_of(
+                outcome="ok") == 9
+            assert m.get("serving_request_latency_seconds").count == 9
+            assert m.get("serving_batch_size").count == len(seen_batches)
+        finally:
+            mb.close()
+
+    def test_sheds_queue_full_with_typed_result(self):
+        release = threading.Event()
+
+        def runner(items):
+            release.wait(5)
+            return items
+
+        mb = MicroBatcher(runner, max_batch=1, max_delay_ms=0,
+                          max_depth=2)
+        try:
+            futs = [mb.submit(i) for i in range(12)]
+            release.set()
+            results = [f.result(timeout=10) for f in futs]
+            shed = [r for r in results if isinstance(r, Overloaded)]
+            served = [r for r in results if not isinstance(r, Overloaded)]
+            assert shed and served
+            assert all(s.reason == "queue_full" for s in shed)
+            assert mb.metrics.get("serving_shed_total").value_of(
+                reason="queue_full") == len(shed)
+            # the queue never exceeded its bound, so at most
+            # max_depth + in-flight requests were ever accepted
+            assert mb.depth == 0
+        finally:
+            mb.close()
+
+    def test_deadline_expired_requests_are_shed_unserved(self):
+        ran = []
+        release = threading.Event()
+
+        def runner(items):
+            release.wait(5)
+            ran.extend(items)
+            return items
+
+        mb = MicroBatcher(runner, max_batch=1, max_delay_ms=0,
+                          max_depth=16)
+        try:
+            blocker = mb.submit("blocker")  # occupies the runner
+            time.sleep(0.05)
+            doomed = mb.submit("doomed", timeout_ms=1)
+            time.sleep(0.05)  # deadline passes while queued
+            release.set()
+            assert blocker.result(timeout=10) == "blocker"
+            r = doomed.result(timeout=10)
+            assert isinstance(r, Overloaded) and r.reason == "deadline"
+            assert "doomed" not in ran  # shed BEFORE compute
+            assert mb.metrics.get("serving_shed_total").value_of(
+                reason="deadline") == 1
+        finally:
+            mb.close()
+
+    def test_runner_error_fails_batch_not_worker(self):
+        calls = []
+
+        def runner(items):
+            calls.append(list(items))
+            if len(calls) == 1:
+                raise RuntimeError("boom")
+            return items
+
+        mb = MicroBatcher(runner, max_batch=8, max_delay_ms=1)
+        try:
+            f1 = mb.submit("a")
+            with pytest.raises(RuntimeError, match="boom"):
+                f1.result(timeout=10)
+            f2 = mb.submit("b")
+            assert f2.result(timeout=10) == "b"
+            assert mb.metrics.get("serving_requests_total").value_of(
+                outcome="error") == 1
+        finally:
+            mb.close()
+
+
+def make_tiny_tokenizer():
+    from perceiver_tpu.tokenizer import create_tokenizer, train_tokenizer
+    from perceiver_tpu.tokenizer.wordpiece import Replace
+
+    corpus = ["the quick brown fox jumps over the lazy dog",
+              "the lazy dog sleeps deeply near the quick fox",
+              "a quick movie about a lazy brown dog"] * 5
+    tok = create_tokenizer(Replace("<br />", " "))
+    train_tokenizer(tok, corpus, vocab_size=VOCAB)
+    assert tok.get_vocab_size() <= VOCAB
+    return tok
+
+
+class TestMLMServerEndToEnd:
+    @pytest.fixture(scope="class")
+    def server(self):
+        metrics = MetricsRegistry()
+        engine = ServingEngine(tiny_mlm_task(), batch_buckets=(1, 4),
+                               seq_buckets=(16, 32), metrics=metrics)
+        server = MLMServer(engine, make_tiny_tokenizer(),
+                           max_delay_ms=10, max_depth=32)
+        yield server
+        server.close()
+
+    def test_concurrent_fill_mask_across_buckets(self, server):
+        short = "the quick [MASK] jumps"             # → seq bucket 16
+        long = ("the quick brown fox jumps over the lazy dog and the "
+                "lazy dog sleeps near the quick [MASK] fox deeply")
+        texts = [short, long, "a [MASK] movie about a [MASK] dog",
+                 short, long]
+        with compile_events() as events:
+            futs = [server.submit(t) for t in texts]
+            results = [f.result(timeout=30) for f in futs]
+        assert events == [], "serving traffic must not compile"
+        for t, r in zip(texts, results):
+            assert not isinstance(r, Overloaded)
+            assert r.text == t
+            assert len(r.predictions) == 3  # top-k fills, decoded
+            assert len(r.masked_positions) == t.count("[MASK]")
+            assert all(len(toks) == 3 for toks in r.topk_tokens)
+            for p in r.predictions:
+                assert "[MASK]" not in p
+
+    def test_fill_parity_with_model_apply(self, server):
+        """Bitwise: the served fill equals top-k over a direct jitted
+        ``model.apply`` on the same encoded+padded request."""
+        text = "the lazy [MASK] sleeps"
+        r = server.fill_mask(text)
+        eng, tok = server.engine, server.tokenizer
+        ids_row = np.asarray(tok.encode(text).ids, np.int32)
+        n = len(ids_row)
+        bucket = eng.bucket_for(1, n)
+        ids = np.full((1, bucket[1]), 0, np.int32)
+        ids[0, :n] = ids_row
+        pad = np.arange(bucket[1])[None, :] >= n
+        model = eng.graph.model
+        logits, _ = jax.jit(
+            lambda p, i, m: model.apply(p, i, m, masking=False,
+                                        policy=eng.policy)
+        )(eng._params, ids, pad)
+        _, idx = jax.lax.top_k(logits.astype(jnp.float32), 3)
+        idx = np.asarray(idx)[0, :n]
+        expect = []
+        for k in range(3):
+            filled = np.where(ids_row == MASK_TOKEN_ID, idx[:, k],
+                              ids_row)
+            expect.append(tok.decode(filled.tolist()))
+        assert r.predictions == expect
+
+    def test_metrics_account_for_work_performed(self, server):
+        m = server.metrics
+        served = m.get("serving_requests_total").value_of(outcome="ok")
+        assert served >= 6  # the two tests above
+        assert m.get("serving_request_latency_seconds").count == served
+        # every dispatch recorded occupancy + waste + a bucket label
+        dispatched = m.get("serving_bucket_dispatch_total").value
+        assert m.get("serving_batch_occupancy").count == dispatched
+        assert m.get("serving_padding_waste_fraction").count == dispatched
+        # engine compiled exactly its warmup grid, nothing more
+        assert m.get("serving_compile_total").value == 4
+        text = server.metrics_text()
+        assert "serving_request_latency_seconds_bucket{le=" in text
+        assert "serving_bucket_dispatch_total{bucket=" in text
+
+    def test_saturated_queue_sheds_with_deadline(self, server):
+        """Deadline shedding under a saturated queue: hold the worker
+        with a long batch, then stack requests whose deadlines expire
+        while queued."""
+        before = server.metrics.get("serving_shed_total").value_of(
+            reason="deadline")
+        futs = [server.submit("the [MASK] dog", timeout_ms=0.01)
+                for _ in range(8)]
+        results = [f.result(timeout=30) for f in futs]
+        shed = [r for r in results if isinstance(r, Overloaded)]
+        assert shed, "0.01 ms deadlines must shed under queueing"
+        assert all(s.reason == "deadline" for s in shed)
+        after = server.metrics.get("serving_shed_total").value_of(
+            reason="deadline")
+        assert after - before == len(shed)
+
+
+class TestPredictCompat:
+    """utils/predict.py is now a serving-engine wrapper (satellite 3)."""
+
+    def _fixture(self):
+        task = tiny_mlm_task()
+        model = task.build()
+        params = model.init(jax.random.key(0))
+        tok = make_tiny_tokenizer()
+
+        def encode_fn(texts):
+            ids, lengths = tok.encode_batch_padded(texts, 16, pad_id=0)
+            pad_mask = np.arange(16)[None, :] >= lengths[:, None]
+            return ids, pad_mask
+
+        return task, model, params, tok, encode_fn
+
+    def test_matches_legacy_implementation(self):
+        from perceiver_tpu.utils.predict import predict_masked_samples
+
+        task, model, params, tok, encode_fn = self._fixture()
+        samples = ["the quick [MASK] jumps", "a [MASK] dog"]
+        got = predict_masked_samples(samples, encode_fn, tok, model,
+                                     params, num_predictions=2)
+        # the reference semantics, computed the pre-serving way
+        ids, pad_mask = encode_fn(samples)
+        logits, _ = jax.jit(
+            lambda p, x, m: model.apply(p, x, m, masking=False)
+        )(params, jnp.asarray(ids), jnp.asarray(pad_mask))
+        _, top = jax.lax.top_k(logits.astype(jnp.float32), 2)
+        top = np.asarray(top)
+        for b in range(len(samples)):
+            mask_pos = np.nonzero(ids[b] == MASK_TOKEN_ID)[0]
+            for k in range(2):
+                filled = ids[b].copy()
+                filled[mask_pos] = top[b, mask_pos, k]
+                assert got[b][k] == tok.decode(filled.tolist())
+
+    def test_second_call_same_shapes_zero_new_compiles(self):
+        """The regression the old helper failed: it re-jit a fresh
+        lambda per call, recompiling every time."""
+        from perceiver_tpu.utils.predict import predict_masked_samples
+
+        task, model, params, tok, encode_fn = self._fixture()
+        samples = ["the [MASK] fox", "the lazy [MASK]"]
+        first = predict_masked_samples(samples, encode_fn, tok, model,
+                                       params)
+        with compile_events() as events:
+            second = predict_masked_samples(samples, encode_fn, tok,
+                                            model, params)
+        assert events == [], f"second predict call compiled: {events}"
+        assert first == second
+        # weight refresh keeps the cache warm too (trainer behavior:
+        # fresh params every validation epoch, same shapes)
+        new_params = model.init(jax.random.key(1))
+        with compile_events() as events:
+            predict_masked_samples(samples, encode_fn, tok, model,
+                                   new_params)
+        assert events == []

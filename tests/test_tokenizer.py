@@ -254,6 +254,28 @@ def test_trainer_parity_with_hf_wordpiece_trainer():
         assert hf_toks == my_toks, t
 
 
+@pytest.fixture(scope="module")
+def batch_tok_path(tmp_path_factory):
+    """Tokenizer JSON for the batch-encode tests: the shipped IMDB
+    artifact when present, otherwise a tokenizer trained once on the
+    synthetic review corpus and cached for the module. The batch tests
+    assert ``encode_batch_padded`` parity against per-doc ``encode``
+    on the SAME tokenizer — which vocab that is doesn't matter, and
+    the serving path (which batch-encodes on the request thread pool,
+    ``serving/api.py``) must hold this parity on trained-from-scratch
+    tokenizers too."""
+    if os.path.exists(SHIPPED):
+        return SHIPPED
+    from perceiver_tpu.data.imdb import _synthetic_reviews
+
+    texts, _ = _synthetic_reviews(600, 0)
+    tok = create_tokenizer(Replace("<br />", " "))
+    train_tokenizer(tok, texts, vocab_size=300)
+    path = str(tmp_path_factory.mktemp("tok") / "batch-tok.json")
+    tok.save(path)
+    return path
+
+
 class TestBatchPaddedEncode:
     """encode_batch_padded: native threaded path vs per-doc encode."""
 
@@ -275,11 +297,11 @@ class TestBatchPaddedEncode:
             lens.append(len(ids))
         return rows, lens
 
-    def test_matches_per_doc_encode(self):
+    def test_matches_per_doc_encode(self, batch_tok_path):
         import numpy as np
         from perceiver_tpu.tokenizer import WordPieceTokenizer
 
-        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok = WordPieceTokenizer.from_file(batch_tok_path)
         tok.no_truncation()
         max_len = 64
         ids, lengths = tok.encode_batch_padded(self.TEXTS, max_len)
@@ -289,22 +311,22 @@ class TestBatchPaddedEncode:
             np.testing.assert_array_equal(ids[i, :n], ref[i, :n])
             assert (ids[i, n:] == 0).all()  # PAD id 0 past length
 
-    def test_python_fallback_identical(self):
+    def test_python_fallback_identical(self, batch_tok_path):
         import numpy as np
         from perceiver_tpu.tokenizer import WordPieceTokenizer
 
-        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok = WordPieceTokenizer.from_file(batch_tok_path)
         native_ids, native_lens = tok.encode_batch_padded(self.TEXTS, 48)
         tok._native_failed = True  # force the pure-Python path
         py_ids, py_lens = tok.encode_batch_padded(self.TEXTS, 48)
         np.testing.assert_array_equal(native_ids, py_ids)
         np.testing.assert_array_equal(native_lens, py_lens)
 
-    def test_many_docs_many_threads(self):
+    def test_many_docs_many_threads(self, batch_tok_path):
         import numpy as np
         from perceiver_tpu.tokenizer import WordPieceTokenizer
 
-        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok = WordPieceTokenizer.from_file(batch_tok_path)
         docs = [f"doc number {i}: some repeated filler text." * (i % 7)
                 for i in range(257)]
         ids, lengths = tok.encode_batch_padded(docs, 32)
@@ -315,14 +337,14 @@ class TestBatchPaddedEncode:
             np.testing.assert_array_equal(ids[i, :len(ref)], ref)
             assert lengths[i] == len(ref)
 
-    def test_unsupported_chain_falls_back(self):
+    def test_unsupported_chain_falls_back(self, batch_tok_path):
         """A non-ASCII Replace disables the raw C++ path but results
         stay identical to per-doc encode."""
         import numpy as np
         from perceiver_tpu.tokenizer import WordPieceTokenizer
         from perceiver_tpu.tokenizer.wordpiece import Replace
 
-        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok = WordPieceTokenizer.from_file(batch_tok_path)
         tok.normalizers.insert(0, Replace("—", " "))
         assert tok._ascii_raw_chain() is None
         ids, lengths = tok.encode_batch_padded(self.TEXTS, 48)
@@ -330,26 +352,26 @@ class TestBatchPaddedEncode:
             ref = tok.encode(t).ids[:48]
             np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
 
-    def test_c0_separator_whitespace_parity(self):
+    def test_c0_separator_whitespace_parity(self, batch_tok_path):
         """\\x1c-\\x1f are whitespace to Python's \\s — the native raw
         path must agree."""
         import numpy as np
         from perceiver_tpu.tokenizer import WordPieceTokenizer
 
-        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok = WordPieceTokenizer.from_file(batch_tok_path)
         texts = ["a\x1cb", "one\x1dtwo\x1ethree\x1ffour", "tab\tok"]
         ids, lengths = tok.encode_batch_padded(texts, 16)
         for i, t in enumerate(texts):
             ref = tok.encode(t).ids[:16]
             np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
 
-    def test_truncation_limit_respected(self):
+    def test_truncation_limit_respected(self, batch_tok_path):
         """enable_truncation below max_len caps every row identically
         on the native and fallback paths."""
         import numpy as np
         from perceiver_tpu.tokenizer import WordPieceTokenizer
 
-        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok = WordPieceTokenizer.from_file(batch_tok_path)
         tok.enable_truncation(5)
         texts = ["a long sentence with many words here",
                  "short café text with some accents okay"]
@@ -361,25 +383,25 @@ class TestBatchPaddedEncode:
             np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
             assert (ids[i, lengths[i]:] == 0).all()
 
-    def test_nul_byte_parity(self):
+    def test_nul_byte_parity(self, batch_tok_path):
         """Embedded NUL bytes must not truncate native word encoding."""
         import numpy as np
         from perceiver_tpu.tokenizer import WordPieceTokenizer
 
-        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok = WordPieceTokenizer.from_file(batch_tok_path)
         texts = [",\x00,", "a\x00b word", "tail nul\x00"]
         ids, lengths = tok.encode_batch_padded(texts, 16)
         for i, t in enumerate(texts):
             ref = tok.encode(t).ids[:16]
             np.testing.assert_array_equal(ids[i, :lengths[i]], ref)
 
-    def test_non_vocab_pad_id(self):
+    def test_non_vocab_pad_id(self, batch_tok_path):
         """pad_id outside the vocab (e.g. an ignore sentinel) works on
         every path."""
         import numpy as np
         from perceiver_tpu.tokenizer import WordPieceTokenizer
 
-        tok = WordPieceTokenizer.from_file(SHIPPED)
+        tok = WordPieceTokenizer.from_file(batch_tok_path)
         ids, lengths = tok.encode_batch_padded(
             ["short text", "café au lait"], 12, pad_id=-100)
         for i in range(2):
