@@ -20,6 +20,7 @@ from perceiver_tpu.analysis.report import (  # noqa: F401
     Violation,
 )
 from perceiver_tpu.analysis.passes import (  # noqa: F401
+    cache_key_stability,
     donation_check,
     dtype_policy,
     hbm_budget,

@@ -166,3 +166,18 @@ def module_fingerprint(text: str) -> str:
     donation layout). Two lowerings of "the same" step that disagree
     here WILL be two compile-cache entries on the chip."""
     return hashlib.sha256(main_signature(text).encode()).hexdigest()[:16]
+
+
+def text_hash(text: str) -> str:
+    """Hash of the FULL module text — the persistent executable
+    cache's key material (``perceiver_tpu/cache``). Stricter than
+    ``module_fingerprint``: trace-time leakage into the graph *body*
+    (a timestamp constant, a host-RNG draw, an id() in a name) changes
+    this hash while leaving the @main signature intact — and silently
+    zeroes the cache hit rate. Host-callback wrapper addresses are
+    canonicalized out first — they are fresh per lowering by
+    construction, and the cache already refuses to serialize
+    callback-bearing executables, so they are noise, not key."""
+    from perceiver_tpu.cache import canonicalize_hlo
+
+    return hashlib.sha256(canonicalize_hlo(text).encode()).hexdigest()

@@ -35,6 +35,17 @@ Rules (names are the ``check`` field of emitted violations):
     count. An unvalidated value fails deep inside a jit trace instead
     of at config time (ADVICE r5 on ``tasks/base.py``).
 
+``uncached-compile``
+    A raw AOT compile — ``.lower(...).compile()`` chained, or
+    ``x.compile()`` where ``x`` was assigned from a ``.lower(...)``
+    call — anywhere outside ``perceiver_tpu/cache/``. Every AOT
+    compile is supposed to flow through the persistent executable
+    cache (``perceiver_tpu.cache.aot_compile``/``compile_lowered``)
+    so warm starts can deserialize instead of recompiling; a raw
+    compile silently opts its call site out. Diagnostics that
+    intentionally measure compilation suppress per line with a
+    reason.
+
 ``serving-host-sync``
     Device synchronization inside ``serving/engine.py``: ``.item()``,
     ``.tolist()``, ``.block_until_ready()``, ``jax.device_get``, and
@@ -325,6 +336,45 @@ def _check_impl_fields(cls: ast.ClassDef, path: str) -> List[Violation]:
     return out
 
 
+def _check_uncached_compiles(tree: ast.AST, path: str) -> List[Violation]:
+    """``uncached-compile``: raw ``.lower().compile()`` outside the
+    cache package (see module docstring). Matches the chained form and
+    the two-statement form (``lowered = f.lower(...); lowered.
+    compile()``) via a module-wide name scan — conservative enough
+    that ``re.compile`` and friends never match (the receiver must be
+    a lowering)."""
+    lowered_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr == "lower":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    lowered_names.add(tgt.id)
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "compile"):
+            continue
+        recv = node.func.value
+        chained = (isinstance(recv, ast.Call)
+                   and isinstance(recv.func, ast.Attribute)
+                   and recv.func.attr == "lower")
+        named = isinstance(recv, ast.Name) and recv.id in lowered_names
+        if chained or named:
+            out.append(Violation(
+                check="uncached-compile", where=f"{path}:{node.lineno}",
+                message="raw .lower().compile() outside "
+                        "perceiver_tpu/cache/ — route AOT compiles "
+                        "through perceiver_tpu.cache (aot_compile / "
+                        "compile_lowered) so warm starts deserialize "
+                        "instead of recompiling, or suppress with "
+                        "'graphcheck: ignore' and a reason"))
+    return out
+
+
 # serving/engine.py: the sync-free dispatch contract (docs/SERVING.md)
 _ENGINE_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
 _NUMPY_CONVERSIONS = {"asarray", "array", "copy", "ascontiguousarray"}
@@ -377,6 +427,8 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     norm = path.replace(os.sep, "/")
     if norm.endswith("serving/engine.py"):
         violations.extend(_check_engine_syncs(tree, imports, path))
+    if "perceiver_tpu/cache/" not in norm:
+        violations.extend(_check_uncached_compiles(tree, path))
     if "/ops/" in norm and {"numpy", "jax.numpy"} <= imports.top_level:
         lineno = next((n.lineno for n in tree.body
                        if isinstance(n, (ast.Import, ast.ImportFrom))), 1)
@@ -427,7 +479,8 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
 
 
 ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
-             "impl-field-validation", "serving-host-sync")
+             "impl-field-validation", "serving-host-sync",
+             "uncached-compile")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

@@ -119,6 +119,7 @@ def donation_check(text: str, *, where: str,
 
 def recompile_budget(target: StepTarget,
                      first: Optional[LoweredStep] = None,
+                     second: Optional[LoweredStep] = None,
                      ) -> Tuple[List[Violation], str]:
     """The compilation-cache key set must be closed: rebuilding a
     target's task + batch from scratch and re-lowering must reproduce
@@ -128,7 +129,8 @@ def recompile_budget(target: StepTarget,
     violations = []
     if first is None:
         first = lower_target(target)
-    second = lower_target(target)
+    if second is None:
+        second = lower_target(target)
     fp1 = hlo.module_fingerprint(first.text)
     fp2 = hlo.module_fingerprint(second.text)
     if fp1 != fp2:
@@ -138,13 +140,51 @@ def recompile_budget(target: StepTarget,
                     f"signatures ({fp1} vs {fp2}) — shape/dtype drift "
                     "in the task config or batch builder means every "
                     "rebuild recompiles"))
-    if first.task_hash != second.task_hash:
+    # task hashes are only comparable when both steps were built in
+    # THIS process (str hashing is salted per process; a cache-served
+    # step carries None and skips the check)
+    if first.task_hash is not None and second.task_hash is not None \
+            and first.task_hash != second.task_hash:
         violations.append(Violation(
             check="recompile_budget", where=target.name,
             message="task config hash differs across rebuilds — the "
                     "config dataclass carries unstable state, so jit "
                     "treats each instance as a new cache key"))
     return violations, fp1
+
+
+def cache_key_stability(target: StepTarget,
+                        first: Optional[LoweredStep] = None,
+                        second: Optional[LoweredStep] = None,
+                        ) -> Tuple[List[Violation], str]:
+    """Two independent lowerings of a canonical target must hash to
+    the SAME full-module text — the persistent executable cache
+    (``perceiver_tpu/cache``) keys on that hash, so any trace-time
+    leakage into the graph body (time, host RNG, ``id()``-derived
+    names) silently zeroes the warm-start hit rate long before it
+    shows up anywhere else. ``recompile_budget`` only pins the @main
+    signature; this pass pins every byte. When ``first`` came from a
+    persistent lowering record, the comparison spans processes — the
+    exact reuse the executable cache performs."""
+    violations = []
+    if first is None:
+        first = lower_target(target)
+    if second is None:
+        second = lower_target(target)
+    h1 = hlo.text_hash(first.text)
+    h2 = hlo.text_hash(second.text)
+    if h1 != h2:
+        span = ("a previous process's lowering and a fresh one"
+                if first.cached else "two fresh lowerings")
+        violations.append(Violation(
+            check="cache_key_stability", where=target.name,
+            message=f"{span} of this target hash to different module "
+                    f"text ({h1[:16]} vs {h2[:16]}) — something leaks "
+                    "trace-time state (time/RNG/object ids) into the "
+                    "graph, which zeroes the executable-cache hit "
+                    "rate; diff the two lowerings to find the "
+                    "drifting op"))
+    return violations, h1
 
 
 # --- hbm_budget --------------------------------------------------------------
@@ -244,14 +284,22 @@ def hbm_budget(bytes_accessed: Optional[float], *, where: str,
 
 
 def run_graph_checks(targets: Sequence[StepTarget] = CANONICAL_TARGETS,
-                     *, recompile: bool = True) -> Report:
+                     *, recompile: bool = True, cache=None) -> Report:
     """Lower each target and run all graph passes. ``recompile=False``
-    skips the second lowering per target (the fast tier-1 subset)."""
+    skips the second lowering per target (the fast tier-1 subset).
+
+    ``cache`` reuses persistent lowering records
+    (``perceiver_tpu.cache.ExecutableCache``): the text passes then
+    gate the recorded lowering — identical to a fresh one by key
+    construction — and the double-lowering passes compare it against
+    ONE fresh trace, which turns ``cache_key_stability`` into a
+    cross-process check and halves (``--graph``) or removes
+    (``--graph --fast``) the lowering bill of a warm run."""
     report = Report()
     fingerprints = {}
     budgets = load_hbm_budgets()
     for target in targets:
-        lowered = lower_target(target)
+        lowered = lower_target(target, cache=cache)
         report.extend(hbm_budget(lowered.bytes_accessed,
                                  where=target.name, budgets=budgets))
         report.ran("hbm_budget")
@@ -270,9 +318,17 @@ def run_graph_checks(targets: Sequence[StepTarget] = CANONICAL_TARGETS,
             expected_donated=lowered.expected_donated))
         report.ran("donation_check")
         if recompile:
-            vs, fp = recompile_budget(target, first=lowered)
+            # the second lowering is always fresh — when `lowered`
+            # came from the cache this compares across processes
+            second = lower_target(target)
+            vs, fp = recompile_budget(target, first=lowered,
+                                      second=second)
             report.extend(vs)
             report.ran("recompile_budget")
+            vs, _h = cache_key_stability(target, first=lowered,
+                                         second=second)
+            report.extend(vs)
+            report.ran("cache_key_stability")
             fingerprints[target.name] = fp
     if recompile and len(set(fingerprints.values())) < len(fingerprints):
         dupes = {n: fp for n, fp in fingerprints.items()

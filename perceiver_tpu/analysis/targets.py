@@ -71,11 +71,18 @@ class LoweredStep:
     # leaves of (params, opt_state) — every one must be donated AND
     # aliased onto an output by lowering
     expected_donated: int
-    task_hash: int
+    # None when the step was reconstructed from a cache record —
+    # Python's salted str hashing makes task hashes incomparable
+    # across processes, so cached steps opt out of that check
+    task_hash: Optional[int]
     # XLA HLO-cost-analysis "bytes accessed" of the lowered module
     # (scan/while bodies counted once) — the hbm_budget pass's metric.
     # None when the backend exposes no lowering-time cost analysis.
     bytes_accessed: Optional[float] = None
+    # True when served from a persistent lowering record (a previous
+    # process's lowering of the same source tree) instead of a fresh
+    # trace — see perceiver_tpu/cache
+    cached: bool = False
 
 
 def cost_bytes_accessed(lowered) -> Optional[float]:
@@ -143,12 +150,30 @@ def make_serve_step(task, batch):
     return jitted, args, expected
 
 
-def lower_target(target: StepTarget) -> LoweredStep:
+def lower_target(target: StepTarget, cache=None) -> LoweredStep:
     """Build the target's task + batch, lower its step (train or
-    serve), and package the properties the graph passes gate on."""
+    serve), and package the properties the graph passes gate on.
+
+    ``cache`` (a ``perceiver_tpu.cache.ExecutableCache``) consults the
+    persistent lowering records first: the key binds the target name
+    to the jax/jaxlib versions, the backend topology, and a content
+    hash of the whole source tree, so a hit is exactly the text a
+    fresh trace of this code would produce — and any code edit is a
+    miss. Fresh lowerings are stored back for the next process."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    key = None
+    if cache is not None:
+        key = cache.lowering_key(target.name)
+        record = cache.load_lowering(key)
+        if record is not None:
+            return LoweredStep(
+                target=target, text=record["text"],
+                expected_donated=int(record["expected_donated"]),
+                task_hash=None,
+                bytes_accessed=record.get("bytes_accessed"),
+                cached=True)
     task, batch = target.build()
     if target.kind == "serve":
         step, args, expected = make_serve_step(task, batch)
@@ -157,9 +182,21 @@ def lower_target(target: StepTarget) -> LoweredStep:
         params, opt_state = args[0], args[1]
         expected = len(jax.tree_util.tree_leaves((params, opt_state)))
     lowered = step.lower(*args)
-    return LoweredStep(target=target, text=lowered.as_text(),
-                       expected_donated=expected, task_hash=hash(task),
-                       bytes_accessed=cost_bytes_accessed(lowered))
+    result = LoweredStep(target=target, text=lowered.as_text(),
+                         expected_donated=expected, task_hash=hash(task),
+                         bytes_accessed=cost_bytes_accessed(lowered))
+    if cache is not None:
+        from perceiver_tpu.analysis import hlo
+
+        cache.store_lowering(key, {
+            "target": target.name,
+            "text": result.text,
+            "expected_donated": result.expected_donated,
+            "bytes_accessed": result.bytes_accessed,
+            "fingerprint": hlo.module_fingerprint(result.text),
+            "text_hash": hlo.text_hash(result.text),
+        })
+    return result
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,39 @@
+"""Version compatibility shims for the parallel kernels.
+
+``shard_map`` moved twice across the jax line this repo spans:
+``jax.experimental.shard_map.shard_map`` (≤ 0.4.x, keyword
+``check_rep``) → ``jax.shard_map`` (0.5+, keyword ``check_vma``).
+The SPMD attention kernels call one spelling — this one — and the
+shim resolves whichever the installed jax provides, translating the
+replication-check keyword. Semantics are identical: ``check_vma``
+(varying-mesh-axes checking) is the renamed successor of
+``check_rep`` (replication checking).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` where available, else the
+    ``jax.experimental.shard_map`` fallback with ``check_vma``
+    translated to its old name ``check_rep``."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside ``shard_map``.
+    ``jax.lax.axis_size`` where it exists; older jax constant-folds
+    ``psum(1, axis)`` to the same Python int (the classic idiom)."""
+    size = getattr(jax.lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return jax.lax.psum(1, axis_name)

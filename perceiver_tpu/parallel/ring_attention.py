@@ -53,6 +53,7 @@ from perceiver_tpu.ops.chunked_attention import (
     finalize_softmax,
     fold_block,
 )
+from perceiver_tpu.parallel.compat import axis_size, shard_map
 
 
 def _init_stats(b, h, lq, d):
@@ -70,7 +71,7 @@ def ring_attention(q, k, v, *, axis_name: str,
     over the FULL key sequence by rotating k/v (+ bias) around the ring
     one hop per step with ``lax.ppermute``.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     b, h, lq, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -141,7 +142,7 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "data", *,
     bias_spec = P(bspec, seq_axis)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(qspec, qspec, qspec, bias_spec),
         out_specs=qspec, check_vma=False)
     def _ring(q, k, v, bias):
@@ -149,7 +150,7 @@ def make_ring_attention(mesh: Mesh, seq_axis: str = "data", *,
                               scale=scale)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(qspec, qspec, qspec),
+        shard_map, mesh=mesh, in_specs=(qspec, qspec, qspec),
         out_specs=qspec, check_vma=False)
     def _ring_nobias(q, k, v):
         return ring_attention(q, k, v, axis_name=seq_axis, scale=scale)
@@ -178,7 +179,7 @@ def make_seq_parallel_cross_attention(mesh: Mesh, seq_axis: str = "data", *,
     bias_spec = P(bspec, seq_axis)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, bias_spec),
         out_specs=q_spec, check_vma=False)
     def _xattn(q, k, v, bias):
@@ -186,7 +187,7 @@ def make_seq_parallel_cross_attention(mesh: Mesh, seq_axis: str = "data", *,
             q, k, v, axis_name=seq_axis, bias=bias, scale=scale)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+        shard_map, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
         out_specs=q_spec, check_vma=False)
     def _xattn_nobias(q, k, v):
         return seq_parallel_cross_attention(

@@ -38,6 +38,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from perceiver_tpu.cache import ExecutableCache, aot_compile, default_cache
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
 from perceiver_tpu.serving.graphs import ServeGraph, build_serve_graph
 from perceiver_tpu.serving.metrics import MetricsRegistry
@@ -79,7 +80,18 @@ class ServingEngine:
                  metrics: Optional[MetricsRegistry] = None,
                  allow_unlisted_buckets: bool = False,
                  warmup: bool = True,
+                 exec_cache=None,
                  seed: int = 0):
+        # persistent compile cache: None resolves the process default
+        # (the PERCEIVER_EXEC_CACHE env dir); a str opens that dir;
+        # False disables caching even when the env var is set
+        if exec_cache is None:
+            exec_cache = default_cache()
+        elif exec_cache is False:
+            exec_cache = None
+        elif isinstance(exec_cache, str):
+            exec_cache = default_cache(exec_cache)
+        self.exec_cache: Optional[ExecutableCache] = exec_cache
         self.task = task
         if graph is None:
             if task is None:
@@ -139,6 +151,7 @@ class ServingEngine:
                    policy: Policy = DEFAULT_POLICY,
                    metrics: Optional[MetricsRegistry] = None,
                    warmup: bool = False,
+                   exec_cache=None,
                    allow_unlisted_buckets: bool = True) -> "ServingEngine":
         """Engine over a prebuilt serve graph + live params — the
         compat path for callers holding a model instead of a task
@@ -147,6 +160,7 @@ class ServingEngine:
         return cls(None, params, graph=graph,
                    batch_buckets=batch_buckets, seq_buckets=seq_buckets,
                    policy=policy, metrics=metrics, warmup=warmup,
+                   exec_cache=exec_cache,
                    allow_unlisted_buckets=allow_unlisted_buckets)
 
     # -- metrics ----------------------------------------------------------
@@ -172,6 +186,17 @@ class ServingEngine:
             buckets=_RATIO_BUCKETS)
         self._m_buckets = m.gauge(
             "serving_compiled_buckets", "compiled bucket executables")
+        self._m_exec_hits = m.counter(
+            "serving_exec_cache_hits_total",
+            "bucket executables deserialized from the persistent "
+            "compile cache (zero-compile warm starts)")
+        self._m_exec_misses = m.counter(
+            "serving_exec_cache_misses_total",
+            "bucket executables the persistent cache could not serve "
+            "(fresh compile performed and stored)")
+        self._m_exec_bytes = m.counter(
+            "serving_exec_cache_bytes_total",
+            "serialized executable bytes, by direction (read|written)")
 
     # -- compilation ------------------------------------------------------
 
@@ -212,14 +237,31 @@ class ServingEngine:
         import jax
         jitted = jax.jit(self.graph.fn,
                          donate_argnums=self.graph.donate_argnums)
-        lowered = jitted.lower(self._params, *self._input_structs(bucket))
-        exe = lowered.compile()
+        # on an exec-cache hit this deserializes the stored executable
+        # — no XLA compile at all; on a miss it compiles once and
+        # stores the blob for the next process
+        exe, info = aot_compile(
+            jitted, (self._params, *self._input_structs(bucket)),
+            cache=self.exec_cache,
+            donate_argnums=self.graph.donate_argnums,
+            label=f"serve:{self.graph.kind}:b{bucket[0]}"
+                  + (f"_s{bucket[1]}" if bucket[1] else ""))
+        if self.exec_cache is not None:
+            if info["hit"]:
+                self._m_exec_hits.inc()
+                self._m_exec_bytes.labels(direction="read").inc(
+                    info["bytes"])
+            else:
+                self._m_exec_misses.inc()
+                self._m_exec_bytes.labels(direction="written").inc(
+                    info["bytes"])
         with self._exe_lock:
             # a concurrent compile of the same bucket may have won —
             # keep the first, count only one executable
             if bucket not in self._exe:
                 self._exe[bucket] = exe
-                self._m_compile.labels(phase=phase).inc()
+                if not info["hit"]:
+                    self._m_compile.labels(phase=phase).inc()
                 self._m_buckets.set(len(self._exe))
             exe = self._exe[bucket]
         return exe

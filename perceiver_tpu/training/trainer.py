@@ -108,6 +108,12 @@ class TrainerConfig:
     # the shard_map impls via --model.attention_impl)
     model_parallel: int = 1
     seq_parallel: int = 1
+    # persistent compile cache directory (perceiver_tpu/cache): the
+    # first dispatch deserializes the step executable instead of
+    # paying the multi-second XLA compile when a prior run at the same
+    # shapes populated it. None falls back to the PERCEIVER_EXEC_CACHE
+    # env var; unset ⇒ caching off.
+    exec_cache_dir: Optional[str] = None
 
     def policy(self) -> Policy:
         if str(self.precision) in ("32", "fp32", "32-true"):
@@ -216,6 +222,10 @@ class Trainer:
         self._single_step_ran = False
         self._eval_step = None
         self._preempted = False
+        # persistent compile cache for the AOT first-dispatch path
+        # (config dir wins over the PERCEIVER_EXEC_CACHE env default)
+        from perceiver_tpu.cache import default_cache
+        self._exec_cache = default_cache(self.config.exec_cache_dir)
         # MFU accounting (SURVEY §5 profiling; BASELINE.md north star)
         self._step_flops: Optional[float] = None
         self._peak_flops = device_peak_flops(
@@ -603,7 +613,9 @@ class Trainer:
                         flops, self._train_step_multi = step_flops_and_fn(
                             self._train_step_multi, state, sharded,
                             num_devices=(self.mesh.devices.size
-                                         if self.mesh is not None else 1))
+                                         if self.mesh is not None else 1),
+                            cache=self._exec_cache,
+                            cache_label="trainer:train_step_multi")
                         self._step_flops = flops or 0.0
                     state, metrics = self._train_step_multi(state, sharded)
                 else:
@@ -618,7 +630,9 @@ class Trainer:
                                 self._train_step, state, sharded,
                                 num_devices=(self.mesh.devices.size
                                              if self.mesh is not None
-                                             else 1))
+                                             else 1),
+                                cache=self._exec_cache,
+                                cache_label="trainer:train_step")
                             self._step_flops = flops or 0.0
                         state, metrics = self._train_step(state, sharded)
                     self._single_step_ran = True

@@ -43,6 +43,14 @@ def main() -> int:
                          "(package + scripts + entry points)")
     ap.add_argument("--json", default=None,
                     help="also write the report as JSON")
+    ap.add_argument("--exec-cache", default=None, metavar="DIR",
+                    help="persistent compile-cache directory "
+                         "(perceiver_tpu/cache): graph passes reuse "
+                         "lowering records from previous runs of the "
+                         "same source tree — a warm --graph --fast "
+                         "run re-lowers nothing, and a warm --graph "
+                         "run lowers each target once (the stability "
+                         "passes then compare across processes)")
     ap.add_argument("--rebaseline-hbm", action="store_true",
                     help="re-measure every canonical target's "
                          "cost-analysis bytes and rewrite the "
@@ -104,6 +112,20 @@ def main() -> int:
         if not (args.all or args.lint or args.graph):
             return 0
 
+    cache = None
+    compile_events = []
+    if args.exec_cache:
+        import jax
+
+        from perceiver_tpu.cache import ExecutableCache
+
+        cache = ExecutableCache(args.exec_cache)
+        # count real XLA compiles so the warm-run contract ("zero
+        # fresh compiles") is observable from the outside
+        jax.monitoring.register_event_listener(
+            lambda name, **kw: compile_events.append(name)
+            if "compile" in name else None)
+
     report = Report()
     if args.all or args.lint:
         paths = args.paths or default_lint_paths(_REPO)
@@ -114,7 +136,13 @@ def main() -> int:
         targets = FAST_TARGETS if args.fast else CANONICAL_TARGETS
         print(f"[check] lowering {len(targets)} canonical target(s) "
               "(CPU backend; no chip needed) ...", file=sys.stderr)
-        report.merge(run_graph_checks(targets, recompile=not args.fast))
+        report.merge(run_graph_checks(targets, recompile=not args.fast,
+                                      cache=cache))
+    if cache is not None:
+        s = cache.stats
+        print(f"[check] exec-cache: hits={s.hits} misses={s.misses} "
+              f"stores={s.stores} xla_compiles={len(compile_events)} "
+              f"dir={cache.path}", file=sys.stderr)
 
     print(report.format())
     if args.json:

@@ -40,7 +40,9 @@ def lowered_target_cache():
 
     cache = {}
 
-    def get(target):
+    # accepts (and ignores) lower_target's persistent-cache kwarg so
+    # tests can monkeypatch this in as a lower_target stand-in
+    def get(target, cache_arg=None, **kwargs):
         if target.name not in cache:
             cache[target.name] = lower_target(target)
         return cache[target.name]
@@ -90,6 +92,7 @@ _SLOW = {
     "test_spmd_attention_impls.py::test_matches_einsum_baseline[seqpar-4]",
     "test_graphcheck.py::test_full_graph_sweep_is_clean",
     "test_graphcheck.py::test_full_lint_sweep_is_clean",
+    "test_exec_cache.py::test_bench_startup_script_cold_warm",
 }
 
 

@@ -1,0 +1,450 @@
+"""Persistent compile cache (ISSUE 4): serialized AOT executables.
+
+The acceptance properties, each pinned here:
+
+- a warm-start ``ServingEngine`` warmup over the FULL bucket grid
+  performs **zero** XLA compiles — asserted via ``jax.monitoring``
+  compile events in a fresh subprocess against a cache a previous
+  subprocess populated;
+- every cache failure mode degrades to a real compile, never a crash:
+  truncated/corrupt blob (+ corrupt/miss counters), doctored version
+  sidecar, missing entries;
+- version skew keys differently (a jaxlib bump can never load a stale
+  executable);
+- eviction respects the size cap, dropping least-recently-used
+  entries first;
+- two engines sharing one cache directory don't race (atomic
+  tempfile + rename publication);
+- ``step_flops_and_fn``'s cache path returns a deserialized
+  executable + sidecar flops on a hit (the trainer's zero-compile
+  first dispatch).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_tpu.cache import (
+    ExecutableCache,
+    aot_compile,
+    default_cache,
+    source_tree_digest,
+)
+from perceiver_tpu.cache import exec_cache as exec_cache_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cache(tmp_path, **kw):
+    kw.setdefault("native", False)
+    return ExecutableCache(str(tmp_path / "ec"), **kw)
+
+
+def _tiny_jit(mult=2.0):
+    return jax.jit(lambda p, x: {"y": p * x + mult},
+                   donate_argnums=(1,))
+
+
+ARGS = (jnp.arange(4.0), jnp.ones((4,)))
+
+
+class TestExecutableEntries:
+    def test_miss_compile_store_then_hit_parity(self, tmp_path):
+        cache = _cache(tmp_path)
+        c1, info1 = aot_compile(_tiny_jit(), ARGS, cache=cache,
+                                donate_argnums=(1,), label="t")
+        assert not info1["hit"] and info1["bytes"] > 0
+        assert cache.stats.misses == 1 and cache.stats.stores == 1
+        c2, info2 = aot_compile(_tiny_jit(), ARGS, cache=cache,
+                                donate_argnums=(1,))
+        assert info2["hit"] and info2["key"] == info1["key"]
+        assert cache.stats.hits == 1
+        out1 = np.asarray(c1(jnp.arange(4.0), jnp.ones((4,)))["y"])
+        out2 = np.asarray(c2(jnp.arange(4.0), jnp.ones((4,)))["y"])
+        np.testing.assert_array_equal(out1, out2)
+        # sidecar carries the cost analysis for warm-path consumers
+        assert info2["sidecar"]["flops"] is not None
+
+    def test_truncated_blob_falls_back_to_compile(self, tmp_path):
+        cache = _cache(tmp_path)
+        _, info = aot_compile(_tiny_jit(), ARGS, cache=cache)
+        blob_path = cache._exe_path(info["key"])
+        blob = open(blob_path, "rb").read()
+        with open(blob_path, "wb") as f:
+            f.write(blob[: len(blob) // 2])
+        c, info2 = aot_compile(_tiny_jit(), ARGS, cache=cache)
+        assert not info2["hit"], "corrupt entry must read as a miss"
+        assert cache.stats.corrupt == 1
+        # the fallback compiled + re-stored a good entry
+        np.testing.assert_array_equal(
+            np.asarray(c(jnp.arange(4.0), jnp.ones((4,)))["y"]),
+            np.arange(4.0) + 2.0)
+        _, info3 = aot_compile(_tiny_jit(), ARGS, cache=cache)
+        assert info3["hit"]
+
+    def test_garbage_blob_and_missing_sidecar(self, tmp_path):
+        cache = _cache(tmp_path)
+        _, info = aot_compile(_tiny_jit(), ARGS, cache=cache)
+        key = info["key"]
+        with open(cache._exe_path(key), "wb") as f:
+            f.write(b"not a pickle at all")
+        assert cache.load_executable(key) is None
+        # the bad entry was dropped outright
+        assert not os.path.exists(cache._exe_path(key))
+        # entry without a sidecar is a miss, not a crash
+        _, info = aot_compile(_tiny_jit(), ARGS, cache=cache)
+        os.unlink(cache._sidecar_path(info["key"]))
+        assert cache.load_executable(info["key"]) is None
+
+    def test_jaxlib_version_mismatch_keys_differently(self, tmp_path,
+                                                      monkeypatch):
+        cache = _cache(tmp_path)
+        text = "func.func public @main() { fake }"
+        key_now = cache.executable_key(text)
+        monkeypatch.setattr(exec_cache_mod, "_versions",
+                            lambda: ("99.0.0", "99.0.0"))
+        key_future = cache.executable_key(text)
+        assert key_now != key_future, \
+            "a jax/jaxlib bump must change every executable key"
+        assert cache.load_executable(key_future) is None
+
+    def test_doctored_version_sidecar_is_dropped(self, tmp_path):
+        """Defense in depth: an entry whose sidecar claims another
+        jaxlib (key collision / hand-copied file) is discarded."""
+        cache = _cache(tmp_path)
+        _, info = aot_compile(_tiny_jit(), ARGS, cache=cache)
+        key = info["key"]
+        side = json.load(open(cache._sidecar_path(key)))
+        side["jaxlib"] = "0.0.1"
+        with open(cache._sidecar_path(key), "w") as f:
+            json.dump(side, f)
+        assert cache.load_executable(key) is None
+        assert not os.path.exists(cache._exe_path(key))
+
+    def test_eviction_respects_size_cap_lru(self, tmp_path):
+        cache = _cache(tmp_path)
+        keys = []
+        for i in range(3):
+            _, info = aot_compile(_tiny_jit(float(i)), ARGS,
+                                  cache=cache)
+            keys.append(info["key"])
+            time.sleep(0.02)  # distinct mtimes for LRU ordering
+        per_entry = cache.entry_bytes() // 3
+        # touch the oldest so the MIDDLE entry becomes LRU
+        assert cache.load_executable(keys[0]) is not None
+        time.sleep(0.02)
+        small = ExecutableCache(cache.path, native=False,
+                                max_bytes=2 * per_entry + per_entry // 2)
+        small._evict()
+        assert small.entry_bytes() <= small.max_bytes
+        assert small.stats.evicted == 1
+        assert not os.path.exists(small._exe_path(keys[1]))
+        assert os.path.exists(small._exe_path(keys[0]))
+        assert os.path.exists(small._exe_path(keys[2]))
+
+    def test_default_cache_env_and_memoization(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.delenv("PERCEIVER_EXEC_CACHE", raising=False)
+        assert default_cache() is None
+        monkeypatch.setenv("PERCEIVER_EXEC_CACHE", str(tmp_path / "d"))
+        c1 = default_cache()
+        assert c1 is not None and c1 is default_cache()
+        assert default_cache(str(tmp_path / "other")) is not c1
+
+    def test_callback_graphs_bypass_cache(self, tmp_path):
+        """jax.debug.print / io_callback graphs bake a host function
+        pointer into the executable — garbage in any other process —
+        so the cache must refuse them (compile fresh every time)."""
+        from perceiver_tpu.cache import has_host_callbacks
+
+        cache = _cache(tmp_path)
+
+        def noisy(p, x):
+            jax.lax.cond(
+                x.sum() > 0,
+                lambda v: jax.debug.print("overflow {n}", n=v),
+                lambda v: None, x.sum())
+            return {"y": p * x}
+
+        jitted = jax.jit(noisy)
+        assert has_host_callbacks(jitted.lower(*ARGS).as_text())
+        for _ in range(2):
+            c, info = aot_compile(jitted, ARGS, cache=cache)
+            assert not info["hit"] and info["key"] is None
+            np.testing.assert_array_equal(
+                np.asarray(c(jnp.arange(4.0), jnp.ones((4,)))["y"]),
+                np.arange(4.0))
+        assert cache.stats.stores == 0 and cache.stats.hits == 0
+
+    def test_executable_key_canonicalizes_callback_ptrs(self, tmp_path):
+        """Two lowerings of the same callback-bearing program differ
+        only in the per-lowering wrapper address — keys must agree
+        (and only those digits are masked)."""
+        cache = _cache(tmp_path)
+
+        def make():
+            def noisy(p, x):
+                jax.lax.cond(
+                    x.sum() > 0,
+                    lambda v: jax.debug.print("n={n}", n=v),
+                    lambda v: None, x.sum())
+                return p * x
+            return noisy
+
+        t1 = jax.jit(make()).lower(*ARGS).as_text()
+        t2 = jax.jit(make()).lower(*ARGS).as_text()
+        assert t1 != t2, "wrapper address should differ per lowering"
+        assert cache.executable_key(t1) == cache.executable_key(t2)
+        # a genuine program difference still keys differently
+        t3 = jax.jit(lambda p, x: p * x + 1).lower(*ARGS).as_text()
+        assert cache.executable_key(t1) != cache.executable_key(t3)
+
+    def test_source_tree_digest_tracks_content(self, tmp_path):
+        a, b = tmp_path / "a", tmp_path / "b"
+        for root in (a, b):
+            root.mkdir()
+            (root / "m.py").write_text("x = 1\n")
+        assert source_tree_digest(str(a)) == source_tree_digest(str(b))
+        c = tmp_path / "c"
+        c.mkdir()
+        (c / "m.py").write_text("x = 2\n")
+        assert source_tree_digest(str(a)) != source_tree_digest(str(c))
+
+
+class TestLoweringRecords:
+    def test_roundtrip_and_corruption(self, tmp_path):
+        cache = _cache(tmp_path)
+        key = cache.lowering_key("seeded_target")
+        assert cache.load_lowering(key) is None
+        record = {"text": "module {}", "expected_donated": 0,
+                  "bytes_accessed": 123.0}
+        assert cache.store_lowering(key, record)
+        got = cache.load_lowering(key)
+        assert got["text"] == "module {}"
+        with open(cache._lowering_path(key), "w") as f:
+            f.write("{ not json")
+        assert cache.load_lowering(key) is None
+        assert cache.stats.corrupt >= 0  # counted as miss, no crash
+
+    def test_key_binds_source_digest(self, tmp_path, monkeypatch):
+        cache = _cache(tmp_path)
+        k1 = cache.lowering_key("t")
+        monkeypatch.setattr(exec_cache_mod, "source_tree_digest",
+                            lambda root=None: "deadbeef")
+        assert cache.lowering_key("t") != k1, \
+            "a source edit must invalidate lowering records"
+
+
+class TestStepFlopsCachePath:
+    def test_hit_returns_sidecar_flops_and_executable(self, tmp_path):
+        from perceiver_tpu.utils.flops import step_flops_and_fn
+
+        cache = _cache(tmp_path)
+        jitted = jax.jit(lambda s, b: (s + b.sum(), b.mean()),
+                         donate_argnums=0)
+        args = (jnp.zeros(()), jnp.ones((8, 8)))
+        flops1, fn1 = step_flops_and_fn(jitted, *args, cache=cache,
+                                        cache_label="test")
+        assert cache.stats.stores == 1
+        flops2, fn2 = step_flops_and_fn(
+            jitted, jnp.zeros(()), jnp.ones((8, 8)), cache=cache)
+        assert cache.stats.hits == 1
+        assert flops2 == flops1 and flops2 is not None
+        s1, _ = fn1(jnp.zeros(()), jnp.ones((8, 8)))
+        s2, _ = fn2(jnp.zeros(()), jnp.ones((8, 8)))
+        assert float(s1) == float(s2) == 64.0
+        # without a cache the lowering-analysis path still returns
+        # the original jit fn (no behavior change)
+        flops3, fn3 = step_flops_and_fn(jitted, jnp.zeros(()),
+                                        jnp.ones((8, 8)))
+        assert fn3 is jitted and flops3 == flops1
+
+
+# --- engine integration ------------------------------------------------------
+
+
+def _tiny_task():
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    return MaskedLanguageModelTask(
+        vocab_size=110, max_seq_len=32, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+
+
+def _arrays(batch, length, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(3, 110, (batch, length)).astype(np.int32)
+    return {"input_ids": ids,
+            "pad_mask": np.zeros((batch, length), bool)}
+
+
+class TestEngineIntegration:
+    def test_two_engines_sharing_one_dir_do_not_race(self, tmp_path):
+        """Concurrent warmups over one cache directory: atomic rename
+        publication means both engines finish with working
+        executables and the directory holds exactly one entry per
+        bucket, no temp droppings."""
+        from perceiver_tpu.serving import ServingEngine, materialize
+
+        cache_dir = str(tmp_path / "shared")
+        task = _tiny_task()
+        engines = [ServingEngine(task, batch_buckets=(1, 2),
+                                 seq_buckets=(16,), warmup=False,
+                                 exec_cache=cache_dir)
+                   for _ in range(2)]
+        errors = []
+
+        def warm(e):
+            try:
+                e.warmup()
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=warm, args=(e,))
+                   for e in engines]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        outs = []
+        for e in engines:
+            assert e.compiled_buckets == ((1, 16), (2, 16))
+            outs.append(materialize(e.dispatch(_arrays(1, 9)), e.graph))
+        for name in outs[0]:
+            np.testing.assert_array_equal(outs[0][name], outs[1][name])
+        names = os.listdir(cache_dir)
+        assert not [n for n in names if n.startswith(".tmp-")]
+        assert len([n for n in names if n.endswith(".exe")]) == 2
+
+    def test_corrupt_entry_engine_falls_back_and_counts(self, tmp_path):
+        from perceiver_tpu.serving import ServingEngine
+
+        cache_dir = tmp_path / "ec"
+        task = _tiny_task()
+        ServingEngine(task, batch_buckets=(1,), seq_buckets=(16,),
+                      exec_cache=str(cache_dir))
+        for name in os.listdir(cache_dir):
+            if name.endswith(".exe"):
+                with open(cache_dir / name, "wb") as f:
+                    f.write(b"rotted")
+        eng = ServingEngine(task, batch_buckets=(1,), seq_buckets=(16,),
+                            exec_cache=str(cache_dir))
+        m = eng.metrics
+        assert eng.compile_count == 1  # real compile happened
+        assert m.get("serving_exec_cache_misses_total").value == 1
+        assert m.get("serving_exec_cache_hits_total").value == 0
+        eng.dispatch(_arrays(1, 16))
+
+
+# --- THE acceptance criterion ------------------------------------------------
+
+_WARM_START_CHILD = """
+import json, os, sys
+sys.path.insert(0, os.getcwd())  # repo root (the test sets cwd)
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from perceiver_tpu.tasks import MaskedLanguageModelTask
+from perceiver_tpu.serving import ServingEngine, materialize
+
+task = MaskedLanguageModelTask(
+    vocab_size=110, max_seq_len=32, num_latents=4,
+    num_latent_channels=8, num_encoder_layers=1,
+    num_encoder_self_attention_layers_per_block=1,
+    num_encoder_cross_attention_heads=1,
+    num_encoder_self_attention_heads=1,
+    num_decoder_cross_attention_heads=1, loss_impl="dense")
+engine = ServingEngine(task, batch_buckets=(1, 2),
+                       seq_buckets=(16, 32), warmup=False,
+                       exec_cache=sys.argv[1])
+events = []
+jax.monitoring.register_event_listener(
+    lambda name, **kw: events.append(name) if "compile" in name
+    else None)
+engine.warmup()
+res = engine.dispatch({
+    "input_ids": np.full((1, 10), 5, np.int32),
+    "pad_mask": np.zeros((1, 10), bool)})
+out = materialize(res, engine.graph)
+m = engine.metrics
+print(json.dumps({
+    "compile_events": events,
+    "engine_compiles": engine.compile_count,
+    "buckets": sorted([b, s] for (b, s) in engine.compiled_buckets),
+    "hits": m.get("serving_exec_cache_hits_total").value,
+    "misses": m.get("serving_exec_cache_misses_total").value,
+    "bytes_read": m.get("serving_exec_cache_bytes_total").value_of(
+        direction="read"),
+    "out0": np.asarray(out["filled_ids"]).tolist(),
+}))
+"""
+
+
+def _run_warm_start_child(script_path, cache_dir):
+    r = subprocess.run(
+        [sys.executable, str(script_path), str(cache_dir)],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO,
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_warm_start_full_grid_zero_compiles_across_processes(tmp_path):
+    """Acceptance: a fresh process against a pre-populated cache warms
+    the FULL bucket grid with zero XLA compiles (jax.monitoring), all
+    buckets present, and bitwise-identical outputs."""
+    script = tmp_path / "warm_child.py"
+    script.write_text(_WARM_START_CHILD)
+    cache_dir = tmp_path / "cache"
+
+    cold = _run_warm_start_child(script, cache_dir)
+    assert cold["misses"] == 4 and cold["engine_compiles"] == 4
+    assert cold["compile_events"], "cold warmup must really compile"
+
+    warm = _run_warm_start_child(script, cache_dir)
+    assert warm["compile_events"] == [], (
+        "warm-start warmup over the full bucket grid must perform "
+        f"ZERO XLA compiles, saw {warm['compile_events']}")
+    assert warm["engine_compiles"] == 0
+    assert warm["hits"] == 4 and warm["misses"] == 0
+    assert warm["bytes_read"] > 0
+    assert warm["buckets"] == [[1, 16], [1, 32], [2, 16], [2, 32]]
+    assert warm["out0"] == cold["out0"], \
+        "deserialized executables must reproduce compiled outputs"
+
+
+def test_bench_startup_script_cold_warm(tmp_path):
+    """scripts/bench_startup.py emits bench.py-format cold/warm JSON
+    with the warm serving phase compile-free. Slow-marked."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_startup.py"),
+         "--cache-dir", str(tmp_path / "bc"), "--keep-cache"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO,
+        capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    lines = [json.loads(line) for line in r.stdout.splitlines()
+             if line.strip().startswith("{")]
+    by_metric = {obj["metric"]: obj for obj in lines}
+    assert set(by_metric) == {"serving_warm_start_speedup",
+                              "trainer_warm_start_speedup"}
+    for obj in lines:
+        assert set(obj) == {"metric", "value", "unit", "vs_baseline",
+                            "detail"}
+        assert obj["unit"] == "x" and obj["value"] > 0
+        assert obj["detail"]["warm_s"] < obj["detail"]["cold_s"]
+        assert obj["detail"]["warm_exec_cache_misses"] == 0
+        assert obj["detail"]["warm_xla_compiles"] == 0

@@ -25,12 +25,14 @@ from perceiver_tpu.analysis import (
     SERVING_TARGETS,
     StepTarget,
     TransferAllow,
+    cache_key_stability,
     donation_check,
     dtype_policy,
     hbm_budget,
     hlo,
     lint_source,
     load_hbm_budgets,
+    lower_target,
     recompile_budget,
     run_graph_checks,
     transfer_guard,
@@ -219,6 +221,70 @@ def test_recompile_budget_fails_on_drifting_shapes():
     violations, _ = recompile_budget(target)
     assert any("different step signatures" in v.message
                for v in violations)
+
+
+# --- cache_key_stability ----------------------------------------------------
+
+
+def _fake_lowered(text, cached=False, name="seeded"):
+    from perceiver_tpu.analysis.targets import LoweredStep
+
+    target = StepTarget(name=name, build=lambda: (None, None))
+    return LoweredStep(target=target, text=text, expected_donated=0,
+                       task_hash=None, cached=cached)
+
+
+def test_cache_key_stability_fails_on_body_drift():
+    """Same @main signature, different body — the leakage class
+    recompile_budget cannot see but that zeroes the exec-cache hit
+    rate (a trace-time timestamp/RNG constant in the graph)."""
+    sig = ("func.func public @main(%arg0: tensor<2x2xf32>) -> "
+           "tensor<2x2xf32> {\n")
+    a = _fake_lowered(sig + "  const 0.123\n}\n")
+    b = _fake_lowered(sig + "  const 0.456\n}\n")
+    target = a.target
+    rc, _ = recompile_budget(target, first=a, second=b)
+    assert not rc, "signature matches — recompile_budget is blind here"
+    violations, _ = cache_key_stability(target, first=a, second=b)
+    assert violations
+    assert "zeroes the executable-cache hit rate" in \
+        violations[0].message
+
+
+def test_cache_key_stability_reports_cross_process_span():
+    a = _fake_lowered("module { A }", cached=True)
+    b = _fake_lowered("module { B }")
+    violations, _ = cache_key_stability(a.target, first=a, second=b)
+    assert "previous process" in violations[0].message
+
+
+def test_cache_key_stability_passes_stable_target():
+    target = StepTarget(name="tiny_stable",
+                        build=lambda: (_tiny_mlm(), _tiny_batch()))
+    violations, text_hash = cache_key_stability(target)
+    assert not violations
+    assert text_hash
+
+
+def test_cache_key_stability_across_lowering_cache(tmp_path):
+    """lower_target round-trips through a persistent lowering record
+    and the stability pass compares record-vs-fresh cleanly — the
+    warm check.py --graph path."""
+    from perceiver_tpu.cache import ExecutableCache
+
+    cache = ExecutableCache(str(tmp_path / "ec"), native=False)
+    target = StepTarget(name="tiny_stable_cached",
+                        build=lambda: (_tiny_mlm(), _tiny_batch()))
+    fresh = lower_target(target, cache=cache)
+    assert not fresh.cached and cache.stats.stores == 1
+    recalled = lower_target(target, cache=cache)
+    assert recalled.cached and recalled.text == fresh.text
+    assert recalled.bytes_accessed == fresh.bytes_accessed
+    assert recalled.expected_donated == fresh.expected_donated
+    violations, _ = cache_key_stability(target, first=recalled)
+    assert not violations
+    rc, _ = recompile_budget(target, first=recalled)
+    assert not rc
 
 
 # --- hbm_budget -------------------------------------------------------------
@@ -605,6 +671,57 @@ def test_lint_clean_on_fixed_tree_files():
             assert not lint_source(f.read(), rel), rel
 
 
+# --- uncached-compile -------------------------------------------------------
+
+_RAW_COMPILE_CHAINED = """
+import jax
+
+def build(fn, args):
+    return jax.jit(fn).lower(*args).compile()
+"""
+
+_RAW_COMPILE_TWO_STEP = """
+import jax
+
+def build(fn, args):
+    lowered = jax.jit(fn).lower(*args)
+    return lowered.compile()
+"""
+
+_RE_COMPILE_CLEAN = """
+import re
+
+PATTERN = re.compile(r"x+")
+
+def scan(text):
+    return re.compile("y").findall(text) + PATTERN.findall(text)
+"""
+
+
+def test_lint_uncached_compile_flags_chained_form():
+    assert "uncached-compile" in _checks(_RAW_COMPILE_CHAINED)
+
+
+def test_lint_uncached_compile_flags_two_step_form():
+    assert "uncached-compile" in _checks(_RAW_COMPILE_TWO_STEP)
+
+
+def test_lint_uncached_compile_exempts_cache_package():
+    assert "uncached-compile" not in _checks(
+        _RAW_COMPILE_CHAINED, "perceiver_tpu/cache/exec_cache.py")
+
+
+def test_lint_uncached_compile_ignores_re_compile():
+    assert not _checks(_RE_COMPILE_CLEAN)
+
+
+def test_lint_uncached_compile_suppression():
+    suppressed = _RAW_COMPILE_CHAINED.replace(
+        ".compile()",
+        ".compile()  # graphcheck: ignore — seeded diagnostic")
+    assert "uncached-compile" not in _checks(suppressed)
+
+
 # --- headline regression + full sweep ---------------------------------------
 
 
@@ -640,7 +757,7 @@ def test_full_graph_sweep_is_clean(monkeypatch, lowered_target_cache):
 
     first_seen = set()
 
-    def once_cached(target):
+    def once_cached(target, cache=None):
         if target.name not in first_seen:
             first_seen.add(target.name)
             return lowered_target_cache(target)
@@ -651,7 +768,8 @@ def test_full_graph_sweep_is_clean(monkeypatch, lowered_target_cache):
     assert report.ok, report.format()
     assert set(report.checks_run) == {"dtype_policy", "transfer_guard",
                                       "donation_check",
-                                      "recompile_budget", "hbm_budget"}
+                                      "recompile_budget", "hbm_budget",
+                                      "cache_key_stability"}
 
 
 def test_check_cli_all_exits_zero():
@@ -670,6 +788,53 @@ def test_check_cli_all_exits_zero():
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
         capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+
+
+def test_check_cli_exec_cache_second_run_warm():
+    """``check.py --graph --fast --exec-cache DIR`` twice: the second
+    run reuses every lowering record (misses=0), performs zero XLA
+    compiles, and is measurably faster. Tier-1 — this is the CI face
+    of the persistent-cache satellite."""
+    import os
+    import re
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as tmp:
+        cmd = [sys.executable,
+               os.path.join(root, "scripts", "check.py"),
+               "--graph", "--fast", "--exec-cache",
+               os.path.join(tmp, "ec")]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        t0 = time.perf_counter()
+        r1 = subprocess.run(cmd, env=env, capture_output=True,
+                            text=True, timeout=600)
+        cold_s = time.perf_counter() - t0
+        assert r1.returncode == 0, f"\n{r1.stdout}\n{r1.stderr}"
+        t0 = time.perf_counter()
+        r2 = subprocess.run(cmd, env=env, capture_output=True,
+                            text=True, timeout=600)
+        warm_s = time.perf_counter() - t0
+        assert r2.returncode == 0, f"\n{r2.stdout}\n{r2.stderr}"
+
+        def stats(stderr):
+            m = re.search(r"exec-cache: hits=(\d+) misses=(\d+) "
+                          r"stores=(\d+) xla_compiles=(\d+)", stderr)
+            assert m, stderr
+            return tuple(int(g) for g in m.groups())
+
+        n = len([t for t in CANONICAL_TARGETS
+                 if t.name != "seg_512x512_b1"])
+        assert stats(r1.stderr) == (0, n, n, stats(r1.stderr)[3])
+        hits, misses, stores, compiles = stats(r2.stderr)
+        assert (hits, misses, stores) == (n, 0, 0)
+        assert compiles == 0, "warm check run must not compile"
+        assert warm_s < 0.6 * cold_s, (
+            f"warm run {warm_s:.1f}s not measurably faster than cold "
+            f"{cold_s:.1f}s")
 
 
 def test_full_lint_sweep_is_clean():

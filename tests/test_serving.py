@@ -468,3 +468,77 @@ class TestPredictCompat:
             predict_masked_samples(samples, encode_fn, tok, model,
                                    new_params)
         assert events == []
+
+    def test_repeat_shapes_warm_across_processes(self, tmp_path):
+        """With PERCEIVER_EXEC_CACHE set, the serving engine behind
+        ``predict_masked_samples`` persists its lazily-compiled
+        executables — a SECOND PROCESS at the same shapes performs
+        zero XLA compiles during predict and reproduces the first
+        process's fills bitwise."""
+        import json
+        import os
+        import subprocess
+        import sys
+
+        script = tmp_path / "predict_child.py"
+        script.write_text(_PREDICT_CHILD)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        results = []
+        for _ in range(2):
+            r = subprocess.run(
+                [sys.executable, str(script)],
+                env=dict(os.environ, JAX_PLATFORMS="cpu",
+                         PERCEIVER_EXEC_CACHE=str(tmp_path / "ec")),
+                cwd=repo, capture_output=True, text=True, timeout=600)
+            assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+            results.append(json.loads(
+                r.stdout.strip().splitlines()[-1]))
+        first, second = results
+        assert first["predict_compile_events"] > 0
+        assert second["predict_compile_events"] == 0, \
+            "warm-process predict must not compile"
+        assert second["preds"] == first["preds"]
+
+
+_PREDICT_CHILD = """
+import json, os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from perceiver_tpu.tasks import MaskedLanguageModelTask
+from perceiver_tpu.tokenizer import create_tokenizer, train_tokenizer
+from perceiver_tpu.tokenizer.wordpiece import Replace
+from perceiver_tpu.utils.predict import predict_masked_samples
+
+corpus = ["the quick brown fox jumps over the lazy dog",
+          "the lazy dog sleeps deeply near the quick fox",
+          "a quick movie about a lazy brown dog"] * 5
+tok = create_tokenizer(Replace("<br />", " "))
+train_tokenizer(tok, corpus, vocab_size=110)
+task = MaskedLanguageModelTask(
+    vocab_size=110, max_seq_len=32, num_latents=4,
+    num_latent_channels=8, num_encoder_layers=1,
+    num_encoder_self_attention_layers_per_block=1,
+    num_encoder_cross_attention_heads=1,
+    num_encoder_self_attention_heads=1,
+    num_decoder_cross_attention_heads=1, loss_impl="dense")
+model = task.build()
+params = model.init(jax.random.key(0))
+
+def encode_fn(texts):
+    ids, lengths = tok.encode_batch_padded(texts, 16, pad_id=0)
+    pad_mask = np.arange(16)[None, :] >= lengths[:, None]
+    return ids, pad_mask
+
+events = []
+jax.monitoring.register_event_listener(
+    lambda name, **kw: events.append(name) if "compile" in name
+    else None)
+preds = predict_masked_samples(
+    ["the quick [MASK] jumps", "a [MASK] dog"], encode_fn, tok,
+    model, params, num_predictions=2)
+print(json.dumps({"predict_compile_events": len(events),
+                  "preds": preds}))
+"""
