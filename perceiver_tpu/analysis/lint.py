@@ -46,6 +46,16 @@ Rules (names are the ``check`` field of emitted violations):
     intentionally measure compilation suppress per line with a
     reason.
 
+``silent-swallow``
+    Broad exception handlers that discard the failure: a bare
+    ``except:`` (it also eats ``KeyboardInterrupt``/``SystemExit``),
+    or an ``except Exception``/``except BaseException`` whose body is
+    only ``pass``/``...``. Silently swallowed errors are how a
+    production system loses data without logging a byte
+    (docs/RESILIENCE.md) — every such handler must either narrow the
+    exception type, handle it visibly, or carry a reason comment on
+    the ``except``/``pass`` line explaining why discarding is correct.
+
 ``serving-host-sync``
     Device synchronization inside ``serving/engine.py``: ``.item()``,
     ``.tolist()``, ``.block_until_ready()``, ``jax.device_get``, and
@@ -375,6 +385,67 @@ def _check_uncached_compiles(tree: ast.AST, path: str) -> List[Violation]:
     return out
 
 
+_BROAD_EXCEPTIONS = {"Exception", "BaseException"}
+
+
+def _has_reason_comment(lines: List[str], lineno: int) -> bool:
+    """A non-empty ``#`` comment on the 1-based line counts as the
+    required reason (naive scan is fine: the flagged lines hold only
+    ``except ...:`` / ``pass`` / ``...``, never ``#`` in a string)."""
+    try:
+        line = lines[lineno - 1]
+    except IndexError:
+        return False
+    head, sep, comment = line.partition("#")
+    return bool(sep) and bool(comment.strip())
+
+
+def _is_broad_type(node: Optional[ast.AST]) -> bool:
+    if node is None:
+        return True  # bare except
+    if isinstance(node, ast.Tuple):
+        return any(_is_broad_type(e) for e in node.elts)
+    if isinstance(node, ast.Name):
+        return node.id in _BROAD_EXCEPTIONS
+    if isinstance(node, ast.Attribute):
+        return node.attr in _BROAD_EXCEPTIONS
+    return False
+
+
+def _check_silent_swallow(tree: ast.AST, lines: List[str],
+                          path: str) -> List[Violation]:
+    """``silent-swallow``: see module docstring. A bare ``except:`` is
+    flagged regardless of body; a broad typed handler only when its
+    body is pure ``pass``/``...``. A reason comment on the ``except``
+    line or any body line clears it."""
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        bare = node.type is None
+        swallows = all(
+            isinstance(stmt, ast.Pass)
+            or (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis)
+            for stmt in node.body)
+        if not (bare or (_is_broad_type(node.type) and swallows)):
+            continue
+        check_lines = [node.lineno] + [s.lineno for s in node.body]
+        if any(_has_reason_comment(lines, ln) for ln in check_lines):
+            continue
+        what = ("bare except:" if bare
+                else "except Exception: pass")
+        out.append(Violation(
+            check="silent-swallow", where=f"{path}:{node.lineno}",
+            message=f"{what} silently discards the failure — narrow "
+                    "the exception type, handle it visibly, or add a "
+                    "reason comment on the except/pass line (or "
+                    "'graphcheck: ignore') explaining why discarding "
+                    "is correct"))
+    return out
+
+
 # serving/engine.py: the sync-free dispatch contract (docs/SERVING.md)
 _ENGINE_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
 _NUMPY_CONVERSIONS = {"asarray", "array", "copy", "ascontiguousarray"}
@@ -423,6 +494,7 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     imports = _Imports()
     imports.visit(tree)
     violations: List[Violation] = []
+    violations.extend(_check_silent_swallow(tree, src.splitlines(), path))
 
     norm = path.replace(os.sep, "/")
     if norm.endswith("serving/engine.py"):
@@ -480,7 +552,7 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
 
 ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
              "impl-field-validation", "serving-host-sync",
-             "uncached-compile")
+             "uncached-compile", "silent-swallow")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

@@ -3,50 +3,89 @@ torchvision MNIST semantics: fetch when absent, behind the same
 datamodule surface).
 
 Zero-egress environments are first-class: every fetch is wrapped, uses
-a short connect timeout, and returns False on any failure so callers
-fall back (to local files or synthetic data) instead of crashing.
-``PERCEIVER_TPU_OFFLINE=1`` skips attempts entirely.
+a short connect timeout, retries transient failures a bounded number
+of times with exponential backoff (optionally verifying an expected
+sha256 before publishing), and returns False once the budget is spent
+so callers fall back (to local files or synthetic data) instead of
+crashing. ``PERCEIVER_TPU_OFFLINE=1`` skips attempts entirely.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
+import sys
 import tarfile
+import time
 
 
 def offline() -> bool:
     return os.environ.get("PERCEIVER_TPU_OFFLINE", "") not in ("", "0")
 
 
-# URLs that already failed in this process — retried next process, but
-# never within one (a firewalled host must not stall repeatedly on the
-# same connect timeout during a single run)
+# URLs that already exhausted their retries in this process — retried
+# next process, but never within one (a firewalled host must not stall
+# repeatedly on the same connect timeout during a single run)
 _failed_urls: set = set()
 
 
-def fetch(url: str, dest: str, timeout: float = 15.0) -> bool:
-    """Download ``url`` to ``dest`` atomically. False on any failure.
-    The temp name is per-process so concurrent callers (multi-host
-    runs sharing a data_dir) never interleave writes; last finished
-    rename wins, each with a complete file."""
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def fetch(url: str, dest: str, timeout: float = 15.0, retries: int = 3,
+          backoff_s: float = 0.5, sha256: str = None) -> bool:
+    """Download ``url`` to ``dest`` atomically. False only once every
+    retry is exhausted (with the final error reported on stderr — a
+    flaky mirror should look flaky, not silent).
+
+    Transient failures — connect errors, truncated transfers, and
+    checksum mismatches when ``sha256`` (the expected lowercase hex
+    digest) is given — are retried up to ``retries`` times with
+    exponential backoff. A digest mismatch also deletes the temp file,
+    so a corrupted download can never be published. The temp name is
+    per-process so concurrent callers (multi-host runs sharing a
+    data_dir) never interleave writes; last finished rename wins, each
+    with a complete, verified file."""
     if offline() or url in _failed_urls:
         return False
     tmp = f"{dest}.part.{os.getpid()}"
-    try:
-        import urllib.request
-        with urllib.request.urlopen(url, timeout=timeout) as r, \
-                open(tmp, "wb") as f:
-            shutil.copyfileobj(r, f)
-        os.replace(tmp, dest)
-        return True
-    except Exception:
-        _failed_urls.add(url)
+    last_err = None
+    for attempt in range(max(int(retries), 1)):
+        if attempt and backoff_s > 0:
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+            import urllib.request
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            if sha256 is not None:
+                got = _sha256_file(tmp)
+                if got != sha256.lower():
+                    raise IOError(
+                        f"sha256 mismatch for {url}: got {got}, "
+                        f"want {sha256.lower()}")
+            os.replace(tmp, dest)
+            return True
+        except Exception as e:  # noqa: BLE001 — every failure retries
+            last_err = e
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass  # already gone / never created
+    _failed_urls.add(url)
+    print(f"[download] giving up on {url} after {max(int(retries), 1)} "
+          f"attempt(s): {type(last_err).__name__}: {last_err}",
+          file=sys.stderr)
+    return False
 
 
 def extract_tgz(path: str, dest_dir: str) -> bool:

@@ -1,0 +1,34 @@
+"""Resilience subsystem: deterministic fault injection and the
+defenses it exercises (docs/RESILIENCE.md).
+
+- ``faults``  — named injection points, armed via config or the
+  ``PERCEIVER_FAULTS`` env var; inert and zero-overhead unarmed;
+- ``guard``   — the non-finite-step guard (halt / skip-N-then-rewind
+  policies) shared by ``terminate_on_nan`` and the trainer;
+- ``breaker`` — the circuit breaker behind the serving engine's
+  per-bucket degrade-don't-die behavior.
+
+Training-side wiring lives in ``training/trainer.py`` and
+``training/checkpoint.py`` (verified checkpoints); serving-side in
+``serving/engine.py``/``batcher.py``/``health.py``; the chaos harness
+is ``scripts/chaos.py`` + ``tests/test_resilience.py``.
+"""
+
+from perceiver_tpu.resilience import faults  # noqa: F401
+from perceiver_tpu.resilience.breaker import (  # noqa: F401
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from perceiver_tpu.resilience.faults import (  # noqa: F401
+    FaultInjected,
+    FaultPlan,
+    FaultSpec,
+)
+from perceiver_tpu.resilience.guard import (  # noqa: F401
+    NonFiniteLossError,
+    StepGuard,
+    wrap_train_step,
+    wrap_train_step_multi,
+)

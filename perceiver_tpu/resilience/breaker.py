@@ -1,0 +1,126 @@
+"""Circuit breaker: fail fast while a dependency is down, probe for
+recovery (docs/RESILIENCE.md).
+
+The serving engine keeps one breaker per compiled bucket: repeated
+dispatch failures open it, after which requests are rejected
+immediately with a typed ``Unavailable`` instead of queueing behind a
+dead executable; after a cooldown one probe request is let through
+(half-open), and its outcome decides between recovery and another
+cooldown. The standard three-state machine::
+
+    CLOSED --[threshold consecutive failures]--> OPEN
+    OPEN   --[reset_timeout elapsed]-----------> HALF_OPEN (one probe)
+    HALF_OPEN --[probe success]--> CLOSED
+    HALF_OPEN --[probe failure]--> OPEN
+
+Dependency-free and clock-injectable so tests drive the timeline
+deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Thread-safe three-state breaker.
+
+    ``allow()`` gates work; ``record_success``/``record_failure``
+    report outcomes of work that was allowed. ``on_transition(old,
+    new)`` fires on every state change, always *after* the breaker's
+    lock is released so the callback may freely read breaker state —
+    it is how the engine exports breaker metrics and health.
+    """
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Optional[Callable[[str, str], None]] = None):
+        if failure_threshold < 1 or reset_timeout_s <= 0:
+            raise ValueError("failure_threshold >= 1 and "
+                             "reset_timeout_s > 0 required")
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0          # consecutive, while closed
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _set(self, new: str, fired: list) -> None:
+        old, self._state = self._state, new
+        if new == OPEN:
+            self._opened_at = self._clock()
+        if new != HALF_OPEN:
+            self._probe_in_flight = False
+        if old != new:
+            fired.append((old, new))
+
+    def _notify(self, fired: list) -> None:
+        if self._on_transition is not None:
+            for old, new in fired:
+                self._on_transition(old, new)
+
+    def allow(self) -> bool:
+        """True iff a request may proceed now. In half-open, exactly
+        one caller gets True (the probe) until its outcome lands."""
+        fired: list = []
+        try:
+            with self._lock:
+                if self._state == CLOSED:
+                    return True
+                if self._state == OPEN:
+                    if self._clock() - self._opened_at \
+                            < self.reset_timeout_s:
+                        return False
+                    self._set(HALF_OPEN, fired)
+                # half-open: single probe
+                if self._probe_in_flight:
+                    return False
+                self._probe_in_flight = True
+                return True
+        finally:
+            self._notify(fired)
+
+    def retry_after(self) -> float:
+        """Seconds until the next probe would be allowed (0 when not
+        open) — the backpressure hint carried by ``Unavailable``."""
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(
+                0.0,
+                self.reset_timeout_s - (self._clock() - self._opened_at))
+
+    def record_success(self) -> None:
+        fired: list = []
+        with self._lock:
+            self._failures = 0
+            if self._state != CLOSED:
+                self._set(CLOSED, fired)
+        self._notify(fired)
+
+    def record_failure(self) -> None:
+        fired: list = []
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._set(OPEN, fired)  # failed probe: back to cooldown
+            else:
+                self._failures += 1
+                if self._state == CLOSED \
+                        and self._failures >= self.failure_threshold:
+                    self._set(OPEN, fired)
+        self._notify(fired)

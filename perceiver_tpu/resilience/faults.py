@@ -1,0 +1,234 @@
+"""Deterministic fault injection (docs/RESILIENCE.md).
+
+At production scale, failures are routine inputs, not exceptional
+ones — so every defense in this repo is exercised by *deterministic*
+fault injection rather than hoping an outage reproduces the bug. A
+small set of named injection points is threaded through the stack
+(loader, train step, checkpoint save, preemption poll, serve
+dispatch); arming a :class:`FaultPlan` makes chosen points fire on
+chosen occurrences, and the chaos harness (``scripts/chaos.py``,
+``tests/test_resilience.py``) asserts the system survives.
+
+Contract:
+
+- **Inert and zero-overhead when unarmed.** ``fire()`` is a module
+  global ``None`` check; no fault code runs, no state accumulates, and
+  nothing here ever executes inside a jitted computation — the seams
+  are host-level, so the unarmed tree lowers to byte-identical graphs
+  (gated by the ``cache_key_stability`` pass).
+- **Deterministic when armed.** Each spec fires on an exact window of
+  *occurrences* of its point (``at`` = 0-based index of the first
+  firing call, ``count`` = how many consecutive calls fire), so a
+  chaos run replays bit-for-bit.
+- **Armed via config or environment.** ``arm("spec")`` in-process, or
+  ``PERCEIVER_FAULTS`` in the environment (read at import, which is
+  how subprocess chaos children inherit a plan).
+
+Spec grammar (';'-separated specs)::
+
+    PERCEIVER_FAULTS="train.nonfinite@at=2,count=3;serve.dispatch@at=0"
+
+Known points (arming an unknown name is a loud ``ValueError``):
+
+=======================  ====================================================
+``loader.exception``     raise in the prefetch producer (one per batch)
+``loader.stall``         sleep ``value`` seconds in the producer (default 30)
+``train.nonfinite``      poison one train step's batch to NaN (per step)
+``train.preempt``        report a pending preemption to the trainer
+``ckpt.truncate``        truncate a checkpoint blob after its manifest
+``ckpt.kill_during_save``  SIGKILL this process mid-checkpoint-save
+``serve.dispatch``       raise inside the serving engine's dispatch
+=======================  ====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional
+
+POINTS = frozenset({
+    "loader.exception",
+    "loader.stall",
+    "train.nonfinite",
+    "train.preempt",
+    "ckpt.truncate",
+    "ckpt.kill_during_save",
+    "serve.dispatch",
+})
+
+ENV_VAR = "PERCEIVER_FAULTS"
+
+
+class FaultInjected(RuntimeError):
+    """The typed error raised by exception-type injection points, so
+    chaos assertions can distinguish injected failures from real ones."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault: {point}")
+        self.point = point
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One armed injection point.
+
+    ``at``: 0-based occurrence index of the first firing call.
+    ``count``: number of consecutive firing calls (-1 = forever).
+    ``value``: free parameter (e.g. stall seconds).
+    """
+
+    point: str
+    at: int = 0
+    count: int = 1
+    value: Optional[float] = None
+
+    def __post_init__(self):
+        if self.point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {self.point!r}; known: "
+                f"{sorted(POINTS)}")
+        if self.at < 0 or (self.count < 1 and self.count != -1):
+            raise ValueError(f"invalid fault window in {self}")
+
+    def fires_on(self, occurrence: int) -> bool:
+        if occurrence < self.at:
+            return False
+        return self.count == -1 or occurrence < self.at + self.count
+
+
+class FaultPlan:
+    """A set of armed specs (at most one per point) with per-point
+    occurrence counters. Thread-safe: injection points are hit from
+    loader threads, the batcher worker, and the main loop."""
+
+    def __init__(self, specs):
+        self.specs: Dict[str, FaultSpec] = {}
+        for spec in specs:
+            if spec.point in self.specs:
+                raise ValueError(f"duplicate fault spec for {spec.point}")
+            self.specs[spec.point] = spec
+        self._seen: Dict[str, int] = {p: 0 for p in self.specs}
+        self._fired: Dict[str, int] = {p: 0 for p in self.specs}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        specs = []
+        for raw in text.split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            point, _, params = raw.partition("@")
+            kwargs = {}
+            if params:
+                for pair in params.split(","):
+                    key, _, val = pair.partition("=")
+                    key = key.strip()
+                    if key not in ("at", "count", "value") or not val:
+                        raise ValueError(
+                            f"bad fault param {pair!r} in {raw!r} "
+                            "(want at=N, count=N, value=X)")
+                    kwargs[key] = (float(val) if key == "value"
+                                   else int(val))
+            specs.append(FaultSpec(point.strip(), **kwargs))
+        if not specs:
+            raise ValueError(f"empty fault plan {text!r}")
+        return cls(specs)
+
+    def fire(self, point: str) -> Optional[FaultSpec]:
+        """Count one occurrence of ``point``; return its spec iff this
+        occurrence is inside the armed window."""
+        spec = self.specs.get(point)
+        if spec is None:
+            return None
+        with self._lock:
+            occurrence = self._seen[point]
+            self._seen[point] = occurrence + 1
+            if spec.fires_on(occurrence):
+                self._fired[point] += 1
+                return spec
+        return None
+
+    def counts(self) -> Dict[str, int]:
+        """Fired-injection counts per point (chaos accounting)."""
+        with self._lock:
+            return dict(self._fired)
+
+
+# the armed plan; None = unarmed (the zero-overhead fast path)
+_PLAN: Optional[FaultPlan] = None
+
+
+def arm(plan) -> FaultPlan:
+    """Arm a plan (a FaultPlan or a spec string). Replaces any armed
+    plan; counters start at zero."""
+    global _PLAN
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    _PLAN = plan
+    return plan
+
+
+def disarm() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+def armed(point: str) -> bool:
+    """True iff a plan is armed and has a spec for ``point`` (cheap
+    pre-check so call sites can skip fault-only work entirely)."""
+    plan = _PLAN
+    return plan is not None and point in plan.specs
+
+
+def fire(point: str) -> bool:
+    """Count one occurrence of ``point``; True iff it fires now."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    return plan.fire(point) is not None
+
+
+def maybe_raise(point: str) -> None:
+    """Raise :class:`FaultInjected` iff ``point`` fires."""
+    if fire(point):
+        raise FaultInjected(point)
+
+
+def maybe_stall(point: str = "loader.stall") -> None:
+    """Sleep the spec's ``value`` seconds (default 30) iff it fires."""
+    plan = _PLAN
+    if plan is None:
+        return
+    spec = plan.fire(point)
+    if spec is not None:
+        time.sleep(spec.value if spec.value is not None else 30.0)
+
+
+def maybe_kill(point: str = "ckpt.kill_during_save") -> None:
+    """SIGKILL this process iff ``point`` fires — the crash-only
+    checkpoint test (no handlers run, no cleanup, like a real OOM
+    kill or preemption hard-stop)."""
+    if fire(point):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def counts() -> Dict[str, int]:
+    """Fired counts of the armed plan ({} when unarmed)."""
+    plan = _PLAN
+    return plan.counts() if plan is not None else {}
+
+
+# environment arming: subprocess chaos children inherit the plan via
+# PERCEIVER_FAULTS without any code changes at their entry points
+_env_plan = os.environ.get(ENV_VAR, "").strip()
+if _env_plan:
+    arm(_env_plan)

@@ -1,0 +1,147 @@
+"""Non-finite step guard: one detection path, three policies
+(docs/RESILIENCE.md).
+
+A non-finite loss means the gradients — and after ``apply_updates``
+the parameters — are garbage; without a guard one bad batch poisons
+the run permanently and every later checkpoint silently (the
+``trainer.py`` failure mode this module removes). The guard has two
+halves that share one detection signal, the per-step loss:
+
+* **Device half** (``wrap_train_step``/``wrap_train_step_multi``):
+  the train step is wrapped so params and optimizer state only
+  advance when the step's loss is finite — a bad step consumes its
+  batch and advances rng/step but applies no update. The wrappers
+  also thread every step's loss out of the dispatch (shape ``(K,)``
+  under ``steps_per_execution``), so the host sees *which* step in a
+  scanned block went bad, not just the block mean. Only armed
+  configurations compile these wrappers; with the guard off the
+  trainer jits the pristine step functions and the lowered graphs are
+  byte-identical to before.
+
+* **Host half** (:class:`StepGuard`): consumes the per-step losses
+  after each dispatch and applies the policy —
+
+  - ``halt``: raise :class:`NonFiniteLossError` naming the first bad
+    step (the ``terminate_on_nan`` semantics, now exact inside
+    multi-step blocks);
+  - ``skip``: count isolated bad steps (the device half already
+    skipped their updates); on ``streak_to_rewind`` consecutive bad
+    steps, request a rewind — the trainer restores the last-good
+    anchor checkpoint and replays the data stream deterministically.
+    After ``max_rewinds`` rewinds the guard halts: persistent
+    non-finite losses are a bug, not weather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OFF = "off"
+HALT = "halt"
+SKIP = "skip"
+POLICIES = (OFF, HALT, SKIP)
+
+#: observe() results
+OK = "ok"
+REWIND = "rewind"
+
+
+class NonFiniteLossError(FloatingPointError):
+    """Typed halt: the run must not continue training on garbage."""
+
+    def __init__(self, step: int, detail: str = "terminate_on_nan"):
+        super().__init__(f"Non-finite loss at step {step} ({detail})")
+        self.step = step
+
+
+def wrap_train_step(train_step):
+    """Guarded single step: apply ``train_step`` but keep the previous
+    params/opt_state when the step's loss is non-finite (rng and the
+    step counter still advance — the batch was consumed). Returns
+    ``(state, metrics, losses)`` with ``losses`` shape ``(1,)``."""
+
+    def guarded(state, batch):
+        new_state, metrics = train_step(state, batch)
+        ok = jnp.isfinite(metrics["loss"])
+
+        def sel(new, old):
+            return jnp.where(ok, new, old)
+
+        merged = dataclasses.replace(
+            new_state,
+            params=jax.tree.map(sel, new_state.params, state.params),
+            opt_state=jax.tree.map(sel, new_state.opt_state,
+                                   state.opt_state))
+        return merged, metrics, metrics["loss"][None]
+
+    return guarded
+
+
+def wrap_train_step_multi(train_step):
+    """Guarded K-step scan: each inner step individually guarded, the
+    per-step losses threaded out so the host can attribute a bad step
+    inside the block. Returns ``(state, mean_metrics, losses)`` with
+    ``losses`` shape ``(K,)``."""
+    single = wrap_train_step(train_step)
+
+    def scan_body(state, batch):
+        state, metrics, _ = single(state, batch)
+        return state, metrics
+
+    def guarded_multi(state, stacked):
+        state, metrics = jax.lax.scan(scan_body, state, stacked)
+        return (state, jax.tree.map(lambda m: m.mean(0), metrics),
+                metrics["loss"])
+
+    return guarded_multi
+
+
+class StepGuard:
+    """Host-side policy over per-step losses (see module docstring)."""
+
+    def __init__(self, policy: str, streak_to_rewind: int = 3,
+                 max_rewinds: int = 2):
+        if policy not in (HALT, SKIP):
+            raise ValueError(f"guard policy {policy!r} not in "
+                             f"{(HALT, SKIP)}")
+        if streak_to_rewind < 1 or max_rewinds < 0:
+            raise ValueError("streak_to_rewind >= 1 and "
+                             "max_rewinds >= 0 required")
+        self.policy = policy
+        self.streak_to_rewind = streak_to_rewind
+        self.max_rewinds = max_rewinds
+        self.skipped_total = 0
+        self.rewinds = 0
+        self._streak = 0
+
+    def observe(self, losses, first_step: int) -> str:
+        """Apply the policy to one dispatch's per-step losses.
+        ``first_step`` is the global step *before* the dispatch, so
+        step numbers in errors/metrics are exact. Returns ``OK`` or
+        ``REWIND``; raises :class:`NonFiniteLossError` on halt or an
+        exhausted rewind budget."""
+        losses = np.atleast_1d(np.asarray(losses))
+        for i, value in enumerate(losses):
+            step = first_step + i + 1
+            if np.isfinite(value):
+                self._streak = 0
+                continue
+            if self.policy == HALT:
+                raise NonFiniteLossError(step)
+            self.skipped_total += 1
+            self._streak += 1
+            if self._streak >= self.streak_to_rewind:
+                if self.rewinds >= self.max_rewinds:
+                    raise NonFiniteLossError(
+                        step,
+                        detail=f"{self._streak} consecutive bad steps "
+                               f"after {self.rewinds} rewind(s) — "
+                               "rewind budget exhausted")
+                self.rewinds += 1
+                self._streak = 0
+                return REWIND
+        return OK

@@ -8,6 +8,10 @@ Layers, bottom to top:
   compilation, device-resident params, sync-free dispatch;
 - ``batcher`` — thread-safe micro-batching queue with deadlines and
   typed ``Overloaded`` load shedding;
+- ``errors``  — the typed failure vocabulary (``Unavailable``,
+  ``BatchError``) every layer speaks (docs/RESILIENCE.md);
+- ``health``  — the health/readiness state machine the engine exports
+  via metrics;
 - ``metrics`` — counters/gauges/latency histograms with Prometheus
   text exposition;
 - ``api``     — task front-ends (MLM fill-mask, text/image
@@ -18,6 +22,15 @@ Layers, bottom to top:
 from perceiver_tpu.serving.batcher import (  # noqa: F401
     MicroBatcher,
     Overloaded,
+)
+from perceiver_tpu.serving.errors import (  # noqa: F401
+    BatchError,
+    ServingError,
+    Unavailable,
+)
+from perceiver_tpu.serving.health import (  # noqa: F401
+    HealthMonitor,
+    HealthState,
 )
 from perceiver_tpu.serving.engine import (  # noqa: F401
     RequestTooLarge,

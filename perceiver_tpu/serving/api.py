@@ -64,6 +64,18 @@ class _Server:
     def _run_batch(self, payloads: List[object]) -> Sequence[object]:
         raise NotImplementedError
 
+    @property
+    def health(self):
+        """The engine's :class:`~perceiver_tpu.serving.health.
+        HealthState` — what a /healthz handler reports."""
+        return self.engine.health.state
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (READY or DEGRADED) — what a load balancer's
+        /readyz probe should route on."""
+        return self.engine.health.ready
+
     def metrics_text(self) -> str:
         """Prometheus text exposition of every serving metric."""
         return self.metrics.render()

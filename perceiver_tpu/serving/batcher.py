@@ -14,8 +14,11 @@ Overload semantics are explicit and typed, never an unbounded queue:
 - a request whose per-request deadline (``timeout_ms``) expires while
   it waits in the queue is shed with ``Overloaded("deadline")`` at
   service time, *before* any compute is spent on it;
-- runner exceptions fail only the requests in that batch (delivered
-  via the future), never the worker loop.
+- runner exceptions fail only the requests in that batch — each
+  request's future gets a *typed* error (``serving/errors.py``: typed
+  exceptions pass through, anything else is wrapped in
+  ``BatchError``), the ``serving_failed_batches_total`` counter
+  ticks, and the worker loop is never harmed.
 
 Under saturation the queue depth is therefore bounded by
 ``max_depth``, latency of *accepted* requests is bounded by their
@@ -34,6 +37,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
 
+from perceiver_tpu.serving.errors import BatchError, ServingError, Unavailable
 from perceiver_tpu.serving.metrics import MetricsRegistry
 
 
@@ -99,6 +103,10 @@ class MicroBatcher:
         self._m_served = m.counter("serving_requests_total",
                                    "requests whose future resolved, "
                                    "by outcome")
+        self._m_failed_batches = m.counter(
+            "serving_failed_batches_total",
+            "runner calls that raised (every request in the batch got "
+            "a typed per-request error)")
 
         self._worker = threading.Thread(target=self._loop,
                                         name="micro-batcher", daemon=True)
@@ -188,9 +196,17 @@ class MicroBatcher:
                         f"runner returned {len(results)} results for "
                         f"{len(live)} requests")
             except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+                self._m_failed_batches.inc()
+                # batch-failure isolation: one typed error per request,
+                # never a raw internal traceback or a dead worker
+                err = e if isinstance(e, ServingError) else BatchError(
+                    f"batch of {len(live)} failed: {type(e).__name__}: "
+                    f"{e}", cause=e)
+                outcome = ("unavailable" if isinstance(e, Unavailable)
+                           else "error")
                 for p in live:
-                    self._m_served.labels(outcome="error").inc()
-                    p.future.set_exception(e)
+                    self._m_served.labels(outcome=outcome).inc()
+                    p.future.set_exception(err)
                 continue
             done = self._clock()
             self._m_batch.observe(float(len(live)))

@@ -19,7 +19,13 @@ steady state performs **zero** XLA compiles.
 - requests dispatch to the smallest fitting bucket, padded with inert
   values (PAD tokens / masked key positions / zero pixels);
 - the MLM graph donates its request buffers (they alias the
-  ``filled_ids``/``is_masked`` outputs — see ``serving/graphs.py``).
+  ``filled_ids``/``is_masked`` outputs — see ``serving/graphs.py``);
+- degrade-don't-die: each bucket carries a circuit breaker — repeated
+  dispatch failures open it and requests get a typed ``Unavailable``
+  (with a retry-after hint) instead of piling onto a dead executable;
+  a half-open probe recovers it. Engine health/readiness is an
+  explicit state machine exported via metrics (``serving/health.py``,
+  docs/RESILIENCE.md).
 
 Host-sync discipline: ``dispatch`` never synchronizes on device
 values — no ``.item()``/``.tolist()``/``block_until_ready``/
@@ -34,13 +40,18 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
 from perceiver_tpu.cache import ExecutableCache, aot_compile, default_cache
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+from perceiver_tpu.resilience import faults
+from perceiver_tpu.resilience.breaker import OPEN, CircuitBreaker
+from perceiver_tpu.serving.errors import Unavailable
 from perceiver_tpu.serving.graphs import ServeGraph, build_serve_graph
+from perceiver_tpu.serving.health import HealthMonitor, HealthState
 from perceiver_tpu.serving.metrics import MetricsRegistry
 
 # occupancy/waste are fractions in [0, 1] — linear buckets, not the
@@ -81,7 +92,10 @@ class ServingEngine:
                  allow_unlisted_buckets: bool = False,
                  warmup: bool = True,
                  exec_cache=None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 breaker_failure_threshold: int = 5,
+                 breaker_reset_s: float = 30.0,
+                 breaker_clock=time.monotonic):
         # persistent compile cache: None resolves the process default
         # (the PERCEIVER_EXEC_CACHE env dir); a str opens that dir;
         # False disables caching even when the env var is set
@@ -127,6 +141,14 @@ class ServingEngine:
         self.allow_unlisted_buckets = allow_unlisted_buckets
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._init_metrics()
+        # degrade-don't-die: one circuit breaker per bucket, plus the
+        # health/readiness machine both export (docs/RESILIENCE.md)
+        self.health = HealthMonitor(self.metrics)
+        self._breaker_failure_threshold = breaker_failure_threshold
+        self._breaker_reset_s = breaker_reset_s
+        self._breaker_clock = breaker_clock
+        self._breakers: Dict[Tuple[int, Optional[int]], CircuitBreaker] = {}
+        self._breaker_lock = threading.Lock()
 
         if params is None and checkpoint is not None:
             from perceiver_tpu.training.checkpoint import restore_params
@@ -143,6 +165,9 @@ class ServingEngine:
         self._exe_lock = threading.Lock()
         if warmup:
             self.warmup()
+        # lazy-bucket engines are serveable immediately; warmed engines
+        # become ready once every configured bucket compiled
+        self.health.set(HealthState.READY)
 
     @classmethod
     def from_graph(cls, graph: ServeGraph, params, *,
@@ -152,7 +177,10 @@ class ServingEngine:
                    metrics: Optional[MetricsRegistry] = None,
                    warmup: bool = False,
                    exec_cache=None,
-                   allow_unlisted_buckets: bool = True) -> "ServingEngine":
+                   allow_unlisted_buckets: bool = True,
+                   breaker_failure_threshold: int = 5,
+                   breaker_reset_s: float = 30.0,
+                   breaker_clock=time.monotonic) -> "ServingEngine":
         """Engine over a prebuilt serve graph + live params — the
         compat path for callers holding a model instead of a task
         config. Defaults to exact-shape lazy buckets: the first call
@@ -161,7 +189,10 @@ class ServingEngine:
                    batch_buckets=batch_buckets, seq_buckets=seq_buckets,
                    policy=policy, metrics=metrics, warmup=warmup,
                    exec_cache=exec_cache,
-                   allow_unlisted_buckets=allow_unlisted_buckets)
+                   allow_unlisted_buckets=allow_unlisted_buckets,
+                   breaker_failure_threshold=breaker_failure_threshold,
+                   breaker_reset_s=breaker_reset_s,
+                   breaker_clock=breaker_clock)
 
     # -- metrics ----------------------------------------------------------
 
@@ -197,6 +228,18 @@ class ServingEngine:
         self._m_exec_bytes = m.counter(
             "serving_exec_cache_bytes_total",
             "serialized executable bytes, by direction (read|written)")
+        self._m_dispatch_fail = m.counter(
+            "serving_dispatch_failures_total",
+            "dispatch executions that raised, per bucket")
+        self._m_breaker_transitions = m.counter(
+            "serving_breaker_transitions_total",
+            "circuit-breaker state changes, labeled bucket/to")
+        self._m_breaker_open = m.gauge(
+            "serving_breaker_open_buckets",
+            "buckets currently failing fast (breaker open)")
+        self._m_unavailable = m.counter(
+            "serving_unavailable_total",
+            "requests rejected with typed Unavailable, by reason")
 
     # -- compilation ------------------------------------------------------
 
@@ -287,6 +330,52 @@ class ServingEngine:
                 "against — rebuild the engine for a new architecture")
         self._params = jax.device_put(params)
 
+    # -- failure handling -------------------------------------------------
+
+    def _bucket_name(self, bucket) -> str:
+        return f"b{bucket[0]}" + (f"_s{bucket[1]}" if bucket[1] else "")
+
+    def _breaker_for(self, bucket) -> CircuitBreaker:
+        with self._breaker_lock:
+            breaker = self._breakers.get(bucket)
+            if breaker is None:
+                name = self._bucket_name(bucket)
+                breaker = CircuitBreaker(
+                    failure_threshold=self._breaker_failure_threshold,
+                    reset_timeout_s=self._breaker_reset_s,
+                    clock=self._breaker_clock,
+                    on_transition=lambda old, new, _n=name:
+                        self._on_breaker_transition(_n, old, new))
+                self._breakers[bucket] = breaker
+            return breaker
+
+    def _on_breaker_transition(self, bucket_name: str, old: str,
+                               new: str) -> None:
+        self._m_breaker_transitions.labels(bucket=bucket_name,
+                                           to=new).inc()
+        self._update_health()
+
+    def _update_health(self) -> None:
+        """Health follows the breaker population: any open bucket is
+        DEGRADED, every bucket open is UNAVAILABLE (the machine in
+        serving/health.py). Never demotes below STARTING."""
+        if self.health.state is HealthState.STARTING:
+            return
+        with self._breaker_lock:
+            breakers = list(self._breakers.values())
+        open_count = sum(1 for b in breakers if b.state == OPEN)
+        self._m_breaker_open.set(open_count)
+        if open_count == 0:
+            self.health.set(HealthState.READY)
+        elif open_count == len(breakers):
+            self.health.set(HealthState.UNAVAILABLE)
+        else:
+            self.health.set(HealthState.DEGRADED)
+
+    @property
+    def ready(self) -> bool:
+        return self.health.ready
+
     # -- dispatch ---------------------------------------------------------
 
     def bucket_for(self, batch: int, length: Optional[int] = None
@@ -351,14 +440,32 @@ class ServingEngine:
                     f"input {spec.name!r} shape "
                     f"{tuple(arrays[spec.name].shape)} != {want}")
         bucket = self.bucket_for(n, length)
+        breaker = self._breaker_for(bucket)
+        if not breaker.allow():
+            # fail fast with backpressure instead of queueing work
+            # behind a bucket that keeps failing (docs/RESILIENCE.md)
+            self._m_unavailable.labels(reason="circuit_open").inc()
+            raise Unavailable("circuit_open", bucket=bucket,
+                              retry_after_s=breaker.retry_after())
         with self._exe_lock:
             known = bucket in self._exe
         if known:
             self._m_hits.inc()
-        exe = self._ensure_executable(bucket)
-        outputs = exe(self._params, *self._pad_to_bucket(arrays, bucket))
+        try:
+            exe = self._ensure_executable(bucket)
+            faults.maybe_raise("serve.dispatch")
+            outputs = exe(self._params,
+                          *self._pad_to_bucket(arrays, bucket))
+        except Unavailable:
+            raise
+        except Exception:
+            bname = self._bucket_name(bucket)
+            self._m_dispatch_fail.labels(bucket=bname).inc()
+            breaker.record_failure()
+            raise
+        breaker.record_success()
 
-        bname = f"b{bucket[0]}" + (f"_s{bucket[1]}" if bucket[1] else "")
+        bname = self._bucket_name(bucket)
         self._m_dispatch.labels(bucket=bname).inc()
         self._m_occupancy.observe(n / bucket[0])
         if self.graph.seq_bucketable:

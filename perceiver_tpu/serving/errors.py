@@ -1,0 +1,46 @@
+"""Typed serving errors (docs/RESILIENCE.md).
+
+The serving plane's failure contract: a request either succeeds, is
+shed with a typed ``Overloaded`` result (``batcher.py``), or fails
+with one of these typed exceptions — never a raw internal traceback
+and never a hang. API layers map them 1:1 onto transport codes
+(``Unavailable`` → 503 + Retry-After, ``BatchError`` → 500,
+``RequestTooLarge`` → 413).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+class ServingError(RuntimeError):
+    """Base of every typed serving-plane failure."""
+
+
+class Unavailable(ServingError):
+    """The request was rejected without any compute being spent on it
+    — its bucket's circuit breaker is open (or the engine is not
+    ready). ``retry_after_s`` is the breaker's cooldown remainder."""
+
+    def __init__(self, reason: str,
+                 bucket: Optional[Tuple[int, Optional[int]]] = None,
+                 retry_after_s: float = 0.0):
+        detail = f"unavailable ({reason})"
+        if bucket is not None:
+            detail += f" bucket={bucket}"
+        if retry_after_s > 0:
+            detail += f" retry_after={retry_after_s:.3f}s"
+        super().__init__(detail)
+        self.reason = reason
+        self.bucket = bucket
+        self.retry_after_s = retry_after_s
+
+
+class BatchError(ServingError):
+    """One micro-batch's execution failed; every request in it gets
+    this (per-request delivery, batcher worker unharmed). ``cause``
+    carries the underlying exception."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.cause = cause

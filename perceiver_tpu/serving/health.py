@@ -1,0 +1,78 @@
+"""Health / readiness state machine, exported via metrics
+(docs/RESILIENCE.md).
+
+Serving health is a tiny explicit machine, not an ad-hoc boolean::
+
+    STARTING ──warmup done──> READY <──recovered── DEGRADED
+                                │                      ▲
+                                └──breaker(s) open─────┘
+                     DEGRADED ──all buckets open──> UNAVAILABLE
+                     UNAVAILABLE ──any recovery───> DEGRADED/READY
+
+- ``STARTING``: executables still compiling; not ready.
+- ``READY``: every compiled bucket serving.
+- ``DEGRADED``: some buckets' breakers open — traffic that fits the
+  live buckets is served, the rest gets typed ``Unavailable`` (the
+  degrade-don't-die state).
+- ``UNAVAILABLE``: every bucket's breaker open; nothing dispatches.
+
+Readiness (what a load balancer should route to) is
+``READY or DEGRADED``. The state is exported as the
+``serving_health_state`` gauge (the enum's numeric value),
+``serving_ready`` 0/1, and a ``serving_health_transitions_total``
+counter labeled ``{from,to}`` so flap rates are observable.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+
+from perceiver_tpu.serving.metrics import MetricsRegistry
+
+
+class HealthState(enum.Enum):
+    STARTING = 0
+    READY = 1
+    DEGRADED = 2
+    UNAVAILABLE = 3
+
+
+class HealthMonitor:
+    """Tracks one serving engine's health and mirrors it to metrics."""
+
+    def __init__(self, metrics: MetricsRegistry):
+        self._lock = threading.Lock()
+        self._state = HealthState.STARTING
+        self._m_state = metrics.gauge(
+            "serving_health_state",
+            "0=starting 1=ready 2=degraded 3=unavailable")
+        self._m_ready = metrics.gauge(
+            "serving_ready", "1 iff the engine should receive traffic")
+        self._m_transitions = metrics.counter(
+            "serving_health_transitions_total",
+            "health state changes, labeled from/to")
+        self._m_state.set(self._state.value)
+        self._m_ready.set(0)
+
+    @property
+    def state(self) -> HealthState:
+        with self._lock:
+            return self._state
+
+    @property
+    def ready(self) -> bool:
+        return self.state in (HealthState.READY, HealthState.DEGRADED)
+
+    def set(self, new: HealthState) -> None:
+        with self._lock:
+            old = self._state
+            if new is old:
+                return
+            self._state = new
+            self._m_state.set(new.value)
+            self._m_ready.set(
+                1 if new in (HealthState.READY, HealthState.DEGRADED)
+                else 0)
+            self._m_transitions.labels(**{"from": old.name.lower(),
+                                          "to": new.name.lower()}).inc()

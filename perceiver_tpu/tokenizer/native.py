@@ -218,7 +218,7 @@ class NativeVocab:
         try:
             self._lib.wp_vocab_free(self._handle)
         except Exception:
-            pass
+            pass  # interpreter teardown: ctypes/lib may be gone; leak
 
 
 def count_words(tokenizer, data: Iterable[str]) -> Counter:

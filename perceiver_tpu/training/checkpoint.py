@@ -14,22 +14,126 @@ Orbax writes are async-capable and multi-host-safe (each host writes
 its shard), which is the TPU-native answer to preemption: frequent
 cheap checkpoints instead of elastic recovery (the reference has none
 either, SURVEY §5 failure detection).
+
+Integrity (docs/RESILIENCE.md): every completed save is sealed with an
+atomically-written ``manifest.sha256.json`` (per-file sha256 + size)
+inside the step directory. Restore verifies the newest step against
+its manifest and *falls back* to the newest verified step instead of
+crashing on a truncated/corrupt blob; a step with no manifest (a
+pre-manifest checkpoint, or a crash in the narrow window between
+orbax's atomic commit and the manifest write) is treated as legacy —
+restorable, but ranked like any other step. If every step is provably
+corrupt the restore raises a typed :class:`CheckpointIntegrityError`
+(deliberately NOT a ``ValueError``/``KeyError`` so the trainer's
+optimizer-mismatch degrade path never mistakes corruption for a
+config change). Retention stays bounded by orbax's ``max_to_keep``
+GC; manifests live inside the step dirs and are collected with them.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any, Optional
+import warnings
+from typing import Any, Dict, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from perceiver_tpu.resilience import faults
 from perceiver_tpu.training.state import TrainState
+
+MANIFEST_NAME = "manifest.sha256.json"
+
+#: verify() results
+VERIFIED = "verified"
+CORRUPT = "corrupt"
+UNVERIFIED = "unverified"  # no manifest (legacy / crash window)
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """Every candidate checkpoint step failed manifest verification."""
 
 
 def _abs(path: str) -> str:
     return os.path.abspath(os.path.expanduser(path))
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _manifest_files(step_dir: str):
+    """Relative paths of every file under a committed step dir,
+    excluding the manifest itself."""
+    out = []
+    for root, dirs, files in os.walk(step_dir):
+        dirs.sort()
+        for name in sorted(files):
+            rel = os.path.relpath(os.path.join(root, name), step_dir)
+            if rel != MANIFEST_NAME:
+                out.append(rel)
+    return out
+
+
+def write_manifest(step_dir: str) -> Dict[str, Any]:
+    """Seal a committed checkpoint step: hash every file and publish
+    the manifest atomically (tempfile + rename — a crash mid-write
+    leaves the step unverified, never half-verified)."""
+    files = {}
+    for rel in _manifest_files(step_dir):
+        path = os.path.join(step_dir, rel)
+        files[rel] = {"sha256": _sha256_file(path),
+                      "size": os.path.getsize(path)}
+    manifest = {"version": 1, "files": files}
+    tmp = os.path.join(step_dir, f".{MANIFEST_NAME}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, os.path.join(step_dir, MANIFEST_NAME))
+    return manifest
+
+
+def verify_step(step_dir: str) -> str:
+    """``VERIFIED`` | ``CORRUPT`` | ``UNVERIFIED`` (no manifest).
+    Corrupt = a listed file is missing, resized, or hash-mismatched,
+    or the manifest itself is unreadable."""
+    manifest_path = os.path.join(step_dir, MANIFEST_NAME)
+    if not os.path.exists(manifest_path):
+        return UNVERIFIED
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        for rel, want in manifest["files"].items():
+            path = os.path.join(step_dir, rel)
+            if not os.path.isfile(path) \
+                    or os.path.getsize(path) != want["size"] \
+                    or _sha256_file(path) != want["sha256"]:
+                return CORRUPT
+    except (OSError, ValueError, KeyError, TypeError):
+        return CORRUPT  # unreadable manifest = unverifiable = corrupt
+    return VERIFIED
+
+
+def _truncate_one_blob(step_dir: str) -> None:
+    """``ckpt.truncate`` fault: halve the largest data file in the
+    step dir — post-commit corruption the manifest must catch."""
+    best, best_size = None, -1
+    for rel in _manifest_files(step_dir):
+        size = os.path.getsize(os.path.join(step_dir, rel))
+        if size > best_size:
+            best, best_size = rel, size
+    if best is not None:
+        with open(os.path.join(step_dir, best), "r+b") as f:
+            f.truncate(max(best_size // 2, 1))
 
 
 class CheckpointHook:
@@ -48,6 +152,9 @@ class CheckpointHook:
                 best_fn=best_fn,
                 best_mode=mode,
                 enable_async_checkpointing=True))
+        # step whose async save has been issued but whose integrity
+        # manifest is not written yet (sealed on the next save/wait)
+        self._pending_manifest: Optional[int] = None
         if hparams is not None:
             os.makedirs(self.directory, exist_ok=True)
             with open(os.path.join(self.directory, "hparams.json"),
@@ -56,14 +163,65 @@ class CheckpointHook:
 
     def save(self, step: int, state: TrainState, metrics: dict):
         metrics = {k: float(v) for k, v in metrics.items()}
+        self._finalize_pending()
         self._mgr.save(step, args=ocp.args.StandardSave(
             {"params": state.params, "opt_state": state.opt_state,
              "rng": jax.random.key_data(state.rng), "step": state.step}),
             metrics=metrics)
+        # crash-only checkpoint chaos: a SIGKILL here lands while the
+        # async write/commit is in flight (tests/test_resilience.py)
+        faults.maybe_kill("ckpt.kill_during_save")
+        self._pending_manifest = step
+
+    def _finalize_pending(self) -> None:
+        """Seal the previous async save with its integrity manifest
+        (waits for it to commit first). Process 0 writes; every host
+        verifies on restore."""
+        step = self._pending_manifest
+        if step is None:
+            return
+        self._mgr.wait_until_finished()
+        self._pending_manifest = None
+        step_dir = os.path.join(self.directory, str(step))
+        if jax.process_index() == 0 and os.path.isdir(step_dir):
+            write_manifest(step_dir)
+            if faults.fire("ckpt.truncate"):
+                _truncate_one_blob(step_dir)
+
+    def _steps(self):
+        """Committed step numbers on disk, newest first."""
+        if not os.path.isdir(self.directory):
+            return []
+        return sorted((int(d) for d in os.listdir(self.directory)
+                       if d.isdigit()), reverse=True)
+
+    def verify(self, step: int) -> str:
+        return verify_step(os.path.join(self.directory, str(step)))
+
+    def _newest_restorable_step(self) -> Optional[int]:
+        """Newest step that is not provably corrupt. Corrupt steps are
+        skipped with a warning; if steps exist but all are corrupt,
+        raise the typed integrity error."""
+        steps = self._steps()
+        for step in steps:
+            status = self.verify(step)
+            if status == CORRUPT:
+                warnings.warn(
+                    f"checkpoint step {step} in {self.directory} fails "
+                    f"sha256 manifest verification — skipping it and "
+                    f"falling back to the newest verified checkpoint",
+                    stacklevel=3)
+                continue
+            return step
+        if steps:
+            raise CheckpointIntegrityError(
+                f"every checkpoint step in {self.directory} "
+                f"({steps}) fails manifest verification")
+        return None
 
     def restore_latest(self, template_state: TrainState
                        ) -> Optional[TrainState]:
-        step = self._mgr.latest_step()
+        step = self._newest_restorable_step()
         if step is None:
             return None
         return self.restore(step, template_state)
@@ -74,7 +232,7 @@ class CheckpointHook:
         longer matches the current optimizer/scheduler config (e.g.
         the schedule was changed between runs): restore params + rng +
         step, keep the template's freshly initialized opt_state."""
-        step = self._mgr.latest_step()
+        step = self._newest_restorable_step()
         if step is None:
             return None
         got = _partial_restore(
@@ -103,8 +261,10 @@ class CheckpointHook:
 
     def wait(self):
         self._mgr.wait_until_finished()
+        self._finalize_pending()
 
     def close(self):
+        self._finalize_pending()
         self._mgr.close()
 
 
@@ -161,6 +321,12 @@ def restore_params(path: str, template: Any = None) -> Any:
                        for s in reversed(steps)]
     for c, wrapped in candidates:
         if not os.path.isdir(c):
+            continue
+        if wrapped and verify_step(os.path.dirname(c)) == CORRUPT:
+            # serving-side verified restore: never load a step whose
+            # manifest proves its blobs rotted (docs/RESILIENCE.md)
+            warnings.warn(f"skipping corrupt checkpoint step "
+                          f"{os.path.dirname(c)}", stacklevel=2)
             continue
         if template is not None and wrapped:
             # hook layout stores {params, opt_state, rng, step}; only

@@ -34,6 +34,8 @@ import numpy as np
 import optax
 
 from perceiver_tpu.ops.policy import Policy
+from perceiver_tpu.resilience import faults
+from perceiver_tpu.resilience import guard as guard_mod
 from perceiver_tpu.training.checkpoint import CheckpointHook
 from perceiver_tpu.training.optim import create_optimizer
 from perceiver_tpu.training.state import TrainState
@@ -71,9 +73,36 @@ class TrainerConfig:
     resume_from_checkpoint: Optional[str] = None
     detect_anomaly: bool = False
     # stop training when the loss goes non-finite (trainer.yaml:71).
-    # Checked at the already-synced log boundaries so the async
-    # pipeline is never broken just for the guard.
+    # Implemented as the resilience guard's "halt" policy: per-step
+    # losses are threaded out of every dispatch, so a NaN inside a
+    # steps_per_execution block is attributed to its exact step
+    # instead of the block boundary (docs/RESILIENCE.md).
     terminate_on_nan: bool = False
+    # non-finite step guard policy: "off" | "halt" | "skip".
+    # "halt" = terminate_on_nan. "skip" withholds the parameter update
+    # of isolated bad steps (guard_skipped_steps metric); on
+    # nonfinite_streak consecutive bad steps the trainer restores the
+    # last-good anchor checkpoint (<log_dir>/checkpoints-guard,
+    # sha256-verified) and rewinds the data iterator deterministically,
+    # at most nonfinite_max_rewinds times before halting. Any armed
+    # policy syncs per-step losses each dispatch; "off" keeps the
+    # pristine step functions and graphs byte-identical.
+    nonfinite_policy: str = "off"
+    nonfinite_streak: int = 3
+    nonfinite_max_rewinds: int = 2
+    # extra last-good anchor saves every N steps under the "skip"
+    # policy (0 = anchors at fit start and epoch starts only)
+    guard_anchor_every_n_steps: int = 0
+    # supervised input pipeline: transient loader failures restart the
+    # prefetch producer with exponential backoff, bounded by this
+    # poison-pill budget (0 = die on first error); persistent failures
+    # re-raise once the budget is spent
+    loader_restart_budget: int = 3
+    loader_backoff_s: float = 0.05
+    # deterministic fault-injection plan armed at fit() — the config
+    # twin of the PERCEIVER_FAULTS env var (resilience/faults.py);
+    # None/empty = unarmed (zero overhead)
+    fault_plan: Optional[str] = None
     profiler: Optional[str] = None
     # overlap host batch assembly with device compute: depth of the
     # background prefetch queue (the torch-DataLoader-workers analogue,
@@ -203,6 +232,21 @@ class Trainer:
         # fresh optimizer's schedule count restarts at 0 while
         # global_step resumes): logged lr must match the applied lr
         self._lr_step_offset = 0
+
+        # effective non-finite guard policy: terminate_on_nan is the
+        # legacy spelling of "halt" (one detection path for both)
+        policy = str(self.config.nonfinite_policy or guard_mod.OFF).lower()
+        if policy not in guard_mod.POLICIES:
+            raise ValueError(
+                f"trainer.nonfinite_policy={policy!r} not in "
+                f"{guard_mod.POLICIES}")
+        if policy == guard_mod.OFF and self.config.terminate_on_nan:
+            policy = guard_mod.HALT
+        self._guard_policy = policy
+        self._guard: Optional[guard_mod.StepGuard] = None
+        self._guard_ckpt: Optional[CheckpointHook] = None
+        self._anchor_pos = (0, 0)   # (epoch, batches consumed) at anchor
+        self._anchor_step = -1
 
         apply_accelerator(self.config.accelerator)
 
@@ -356,8 +400,21 @@ class Trainer:
             state, metrics = jax.lax.scan(train_step, state, stacked)
             return state, jax.tree.map(lambda m: m.mean(0), metrics)
 
-        self._train_step = jax.jit(train_step, donate_argnums=0)
-        self._train_step_multi = jax.jit(train_step_multi, donate_argnums=0)
+        if self._guard_policy != guard_mod.OFF:
+            # guarded step functions: bad steps apply no update and
+            # every step's loss is threaded out so the host guard can
+            # attribute/skip/rewind exactly (resilience/guard.py). Only
+            # armed configs compile these — with the guard off the
+            # pristine functions below lower to byte-identical graphs.
+            self._train_step = jax.jit(
+                guard_mod.wrap_train_step(train_step), donate_argnums=0)
+            self._train_step_multi = jax.jit(
+                guard_mod.wrap_train_step_multi(train_step),
+                donate_argnums=0)
+        else:
+            self._train_step = jax.jit(train_step, donate_argnums=0)
+            self._train_step_multi = jax.jit(train_step_multi,
+                                             donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
 
     def _preemption_pending(self) -> bool:
@@ -366,6 +423,10 @@ class Trainer:
         to JAX's coordinated sync point (driven by the coordination
         service's preemption notice) instead of per-host signals, which
         land at different loop positions on different hosts."""
+        if faults.fire("train.preempt"):
+            # injected preemption notice — the chaos twin of SIGTERM
+            self._preempted = True
+            return True
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             try:
@@ -390,12 +451,47 @@ class Trainer:
               f"{os.path.join(self.log_dir, 'checkpoints-preempt')}")
         return True
 
-    def _check_nan(self, metrics):
-        if self.config.terminate_on_nan and not np.isfinite(
-                float(metrics.get("loss", 0.0))):
-            raise FloatingPointError(
-                f"Non-finite loss at step {self.global_step}"
-                " (terminate_on_nan)")
+    # --- non-finite guard ----------------------------------------------------
+
+    def _poison_batch(self, arrays: Dict[str, np.ndarray],
+                      index: Optional[int] = None) -> None:
+        """``train.nonfinite`` chaos seam: overwrite one step's float
+        fields with NaN on the HOST, so a real non-finite loss flows
+        through the unmodified jitted step (the lowered graph never
+        changes; only the data does)."""
+        for v in arrays.values():
+            if np.issubdtype(v.dtype, np.floating):
+                if index is None:
+                    v[...] = np.nan
+                else:
+                    v[index] = np.nan
+
+    def _save_anchor(self, state: TrainState, epoch: int,
+                     batches_done: int) -> None:
+        """Record a last-good rewind target: verified checkpoint plus
+        the deterministic data-stream position it was taken at."""
+        if self._guard_ckpt is None or self.global_step == self._anchor_step:
+            return
+        self._guard_ckpt.save(self.global_step, state, {})
+        self._anchor_pos = (epoch, batches_done)
+        self._anchor_step = self.global_step
+
+    def _guard_rewind(self, template_state: TrainState) -> TrainState:
+        """Restore the newest verified anchor checkpoint; the caller
+        repositions the data iterator at ``self._anchor_pos``."""
+        self._guard_ckpt.wait()
+        restored = self._guard_ckpt.restore_latest(template_state)
+        if restored is None:
+            raise guard_mod.NonFiniteLossError(
+                self.global_step, detail="no anchor checkpoint to "
+                "rewind to")
+        self.global_step = int(restored.step)
+        if jax.process_index() == 0:
+            print(f"[guard] non-finite streak: restored verified "
+                  f"anchor at step {self.global_step}, replaying "
+                  f"epoch {self._anchor_pos[0]} from batch "
+                  f"{self._anchor_pos[1]}", file=sys.stderr, flush=True)
+        return restored
 
     # --- loops ---------------------------------------------------------------
 
@@ -440,6 +536,8 @@ class Trainer:
     def fit(self) -> TrainState:
         """Train with SIGTERM (preemption) handling around the loop."""
         self._preempted = False  # a prior preempted fit() must not leak
+        if self.config.fault_plan:
+            faults.arm(self.config.fault_plan)
         installed, old_term = False, None
         if self.config.preempt_checkpoint:
             try:
@@ -492,6 +590,18 @@ class Trainer:
                 max_to_keep=cfg.save_top_k,
                 monitor=cfg.checkpoint_monitor,
                 hparams=self._hparams())
+        self._guard = None
+        self._guard_ckpt = None
+        self._anchor_pos, self._anchor_step = (0, 0), -1
+        if self._guard_policy != guard_mod.OFF:
+            self._guard = guard_mod.StepGuard(
+                self._guard_policy,
+                streak_to_rewind=cfg.nonfinite_streak,
+                max_rewinds=cfg.nonfinite_max_rewinds)
+            if self._guard_policy == guard_mod.SKIP:
+                self._guard_ckpt = CheckpointHook(
+                    os.path.join(self.log_dir, "checkpoints-guard"),
+                    max_to_keep=1, monitor="")
 
         state = self._build_state()
         self._make_steps()
@@ -547,8 +657,10 @@ class Trainer:
         train_loader = self._process_shard(train_loader)
         if cfg.prefetch_batches > 0:
             from perceiver_tpu.data.prefetch import PrefetchIterator
-            train_loader = PrefetchIterator(train_loader,
-                                            depth=cfg.prefetch_batches)
+            train_loader = PrefetchIterator(
+                train_loader, depth=cfg.prefetch_batches,
+                max_restarts=cfg.loader_restart_budget,
+                backoff_s=cfg.loader_backoff_s)
 
         # sanity validation (trainer.yaml:53)
         if cfg.num_sanity_val_steps and not cfg.fast_dev_run:
@@ -567,7 +679,9 @@ class Trainer:
         stop = False
         t0, samples_since, steps_since = time.time(), 0, 0
         metrics = None
-        for epoch in range(max_epochs):
+        epoch = 0
+        replay_batches = 0  # rewind reposition within the next epoch
+        while epoch < max_epochs:
             self.current_epoch = epoch
             train_loader.set_epoch(epoch)
 
@@ -578,6 +692,16 @@ class Trainer:
                     yield b
 
             batch_iter = epoch_batches()
+            batches_done = 0
+            if replay_batches:
+                # deterministic rewind replay: the loader is
+                # epoch-seeded, so discarding N batches reproduces the
+                # exact stream position the anchor was taken at
+                for _ in itertools.islice(batch_iter, replay_batches):
+                    pass
+                batches_done, replay_batches = replay_batches, 0
+            self._save_anchor(state, epoch, batches_done)
+            rewound = False
             while True:
                 remaining = (cfg.max_steps - self.global_step
                              if cfg.max_steps > 0 else spe)
@@ -605,9 +729,15 @@ class Trainer:
                 # the throughput/MFU measurement window
                 first_single = (spe > 1 and len(group) < spe
                                 and not self._single_step_ran)
+                poison = faults.armed("train.nonfinite")
+                losses = None
                 if len(group) == spe and spe > 1:
                     stacked = {key: np.stack([b[key] for b in group])
                                for key in group[0]}
+                    if poison:
+                        for i in range(len(group)):
+                            if faults.fire("train.nonfinite"):
+                                self._poison_batch(stacked, index=i)
                     sharded = self._shard_batch(stacked, stacked=True)
                     if first_step:
                         flops, self._train_step_multi = step_flops_and_fn(
@@ -617,10 +747,18 @@ class Trainer:
                             cache=self._exec_cache,
                             cache_label="trainer:train_step_multi")
                         self._step_flops = flops or 0.0
-                    state, metrics = self._train_step_multi(state, sharded)
+                    if self._guard is not None:
+                        state, metrics, losses = self._train_step_multi(
+                            state, sharded)
+                    else:
+                        state, metrics = self._train_step_multi(state,
+                                                                sharded)
                 else:
                     # trailing (or single-step-mode) group, step by step
+                    losses = [] if self._guard is not None else None
                     for b in group:
+                        if poison and faults.fire("train.nonfinite"):
+                            self._poison_batch(b)
                         sharded = self._shard_batch(b)
                         if self._step_flops is None:
                             # cost analysis via lowering, or via the AOT
@@ -634,11 +772,42 @@ class Trainer:
                                 cache=self._exec_cache,
                                 cache_label="trainer:train_step")
                             self._step_flops = flops or 0.0
-                        state, metrics = self._train_step(state, sharded)
+                        if self._guard is not None:
+                            state, metrics, loss_i = self._train_step(
+                                state, sharded)
+                            losses.append(loss_i)
+                        else:
+                            state, metrics = self._train_step(state,
+                                                              sharded)
                     self._single_step_ran = True
                 self.global_step += len(group)
+                batches_done += len(group)
                 samples_since += batch_size
                 steps_since += len(group)
+
+                if self._guard is not None:
+                    # per-dispatch host sync of the per-step losses:
+                    # the cost of an armed guard, and the one detection
+                    # path halt/skip/rewind all share
+                    if isinstance(losses, list):
+                        losses_host = np.concatenate(
+                            [np.asarray(x) for x in losses])
+                    else:
+                        losses_host = np.asarray(losses)
+                    action = self._guard.observe(losses_host, prev_step)
+                    if action == guard_mod.REWIND:
+                        state = self._guard_rewind(state)
+                        epoch, replay_batches = self._anchor_pos
+                        metrics = None
+                        t0, samples_since, steps_since = \
+                            time.time(), 0, 0
+                        rewound = True
+                        break
+                    if (cfg.guard_anchor_every_n_steps > 0
+                            and bool(np.isfinite(losses_host).all())
+                            and self.global_step - self._anchor_step
+                            >= cfg.guard_anchor_every_n_steps):
+                        self._save_anchor(state, epoch, batches_done)
                 if first_step or first_single:
                     # this dispatch paid a jit compilation; keep it
                     # out of the throughput/MFU measurement window.
@@ -658,7 +827,6 @@ class Trainer:
                     # fetch (utils/timing.py), not block_until_ready,
                     # which the axon tunnel acks early
                     fence(metrics)
-                    self._check_nan(metrics)
                     dt = time.time() - t0
                     throughput = samples_since / max(dt, 1e-9)
                     if jax.process_index() == 0:
@@ -694,6 +862,11 @@ class Trainer:
                     if util is not None:
                         self.writer.add_scalar("mfu", util,
                                                self.global_step)
+                    if self._guard is not None:
+                        self.writer.add_scalar(
+                            "guard_skipped_steps",
+                            float(self._guard.skipped_total),
+                            self.global_step)
                     t0, samples_since, steps_since = time.time(), 0, 0
 
                 if cfg.preempt_checkpoint and \
@@ -705,12 +878,11 @@ class Trainer:
                     stop = True
                     break
 
-            # close the tail window: a run shorter than the log interval
-            # (or a NaN in the final partial window) must not complete
-            # and checkpoint silently. Gate on metrics, not the timing
-            # counter — the first-step compile reset zeroes the latter.
-            if cfg.terminate_on_nan and metrics is not None:
-                self._check_nan(metrics)
+            if rewound:
+                # restart the loop at the anchor's epoch/batch without
+                # counting an epoch or running validation on the
+                # just-restored state
+                continue
 
             if (epoch % cfg.check_val_every_n_epoch == 0 or stop) \
                     and not self._preempted:  # grace window is short
@@ -733,11 +905,14 @@ class Trainer:
                 t0, samples_since, steps_since = time.time(), 0, 0
             if stop:
                 break
+            epoch += 1
 
         if cfg.profiler:
             jax.profiler.stop_trace()
         if self._ckpt is not None:
             self._ckpt.wait()
+        if self._guard_ckpt is not None:
+            self._guard_ckpt.wait()
         self.final_state = state
         return state
 

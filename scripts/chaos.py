@@ -1,0 +1,404 @@
+#!/usr/bin/env python
+"""Chaos harness: run the fault matrix against a tiny preset and prove
+every defense (docs/RESILIENCE.md).
+
+Each scenario arms one deterministic fault (``resilience/faults.py``)
+in a FRESH subprocess (the ``PERCEIVER_FAULTS`` env seam — exactly how
+a chaos job arms a production binary) and asserts the run still
+reaches its target: training hits its target step with
+verified-checkpoint resume where resumes are involved, and serving
+answers every request with a result or a *typed* error — zero
+unhandled exceptions, zero silent data loss. ``kill_save`` goes one
+step further and SIGKILLs a training victim mid-checkpoint-save in a
+grand-child process (crash-only checkpointing).
+
+Emits one ``bench.py``-format JSON line per scenario::
+
+    {"metric": "chaos_serve_dispatch", "value": 1.0, "unit":
+     "survived", "vs_baseline": null, "detail": {"faults_fired": ...,
+     "recovery_s": ..., ...}}
+
+plus a ``chaos_matrix`` summary line; exits non-zero iff any scenario
+failed. ``--fast`` runs the tier-1 subset
+(``tests/test_chaos.py`` mirrors the ``check.py`` subprocess-gate
+pattern)::
+
+    JAX_PLATFORMS=cpu python scripts/chaos.py --fast
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+TARGET_STEP = 6
+
+
+def _tiny_image_task():
+    from perceiver_tpu.tasks import ImageClassifierTask
+
+    return ImageClassifierTask(
+        image_shape=(28, 28, 1), num_classes=10, num_frequency_bands=4,
+        num_latents=4, num_latent_channels=16, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_decoder_cross_attention_heads=1)
+
+
+def _make_trainer(tmp: str, tag: str, **overrides):
+    from perceiver_tpu.data import MNISTDataModule
+    from perceiver_tpu.training import Trainer, TrainerConfig
+
+    dm = MNISTDataModule(data_dir=os.path.join(tmp, "data"),
+                         batch_size=16, synthetic_train_size=96,
+                         synthetic_test_size=32)
+    cfg = dict(max_steps=TARGET_STEP, max_epochs=8,
+               num_sanity_val_steps=0, log_every_n_steps=1,
+               default_root_dir=os.path.join(tmp, f"logs_{tag}"),
+               enable_checkpointing=False, prefetch_batches=0)
+    cfg.update(overrides)
+    return Trainer(_tiny_image_task(), dm, TrainerConfig(**cfg),
+                   optimizer_init={"class_path": "AdamW",
+                                   "init_args": {"lr": 1e-3}})
+
+
+def _finite(state) -> bool:
+    import jax
+    import numpy as np
+
+    return all(bool(np.isfinite(np.asarray(leaf)).all())
+               for leaf in jax.tree.leaves(state.params)
+               if np.issubdtype(np.asarray(leaf).dtype, np.floating))
+
+
+# --- scenarios (run in a fresh subprocess each) ------------------------------
+
+
+def scenario_loader_crash(tmp: str) -> dict:
+    """Prefetch producer raises twice; the supervisor restarts it with
+    backoff and the run still reaches its target step."""
+    trainer = _make_trainer(tmp, "loader", prefetch_batches=2)
+    state = trainer.fit()
+    assert int(state.step) == TARGET_STEP, int(state.step)
+    assert _finite(state)
+    return {"target_step": TARGET_STEP, "reached": int(state.step)}
+
+
+def scenario_nan_skip(tmp: str) -> dict:
+    """Two isolated non-finite steps are skipped (no parameter update,
+    counter metric) and training completes with finite params."""
+    trainer = _make_trainer(tmp, "nan", nonfinite_policy="skip",
+                            nonfinite_streak=3)
+    state = trainer.fit()
+    assert int(state.step) == TARGET_STEP, int(state.step)
+    assert trainer._guard.skipped_total == 2, trainer._guard.skipped_total
+    assert trainer._guard.rewinds == 0
+    assert _finite(state)
+    return {"target_step": TARGET_STEP, "reached": int(state.step),
+            "skipped_steps": trainer._guard.skipped_total}
+
+
+def scenario_nan_rewind(tmp: str) -> dict:
+    """A streak of bad steps triggers restore of the verified anchor
+    checkpoint + deterministic data rewind; the fault window expires
+    during the replay and the run completes."""
+    trainer = _make_trainer(tmp, "rewind", max_steps=8,
+                            nonfinite_policy="skip", nonfinite_streak=3,
+                            nonfinite_max_rewinds=2)
+    state = trainer.fit()
+    assert int(state.step) == 8, int(state.step)
+    assert trainer._guard.rewinds >= 1
+    assert _finite(state)
+    return {"target_step": 8, "reached": int(state.step),
+            "rewinds": trainer._guard.rewinds,
+            "skipped_steps": trainer._guard.skipped_total}
+
+
+def _checkpointed_run(tmp: str, tag: str, max_steps: int):
+    trainer = _make_trainer(tmp, tag, max_steps=max_steps,
+                            enable_checkpointing=True, save_top_k=2)
+    state = trainer.fit()
+    return trainer, state
+
+
+def scenario_truncated_ckpt(tmp: str) -> dict:
+    """The newest checkpoint's blob is truncated after its manifest was
+    sealed (bit rot); resume detects the mismatch, falls back to the
+    newest VERIFIED step, and still reaches the target."""
+    import warnings
+
+    from perceiver_tpu.training.checkpoint import CheckpointHook
+
+    trainer, _ = _checkpointed_run(tmp, "trunc", max_steps=10)
+    ckpt_dir = os.path.join(trainer.log_dir, "checkpoints")
+    hook = CheckpointHook(ckpt_dir, monitor="")
+    steps = hook._steps()
+    assert len(steps) >= 2, steps
+    statuses = {s: hook.verify(s) for s in steps}
+    assert statuses[steps[0]] == "corrupt", statuses  # fault landed
+    assert statuses[steps[1]] == "verified", statuses
+
+    resume = _make_trainer(tmp, "trunc_resume", max_steps=12,
+                           resume_from_checkpoint=ckpt_dir)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        state = resume.fit()
+    assert any("manifest" in str(w.message) for w in caught), \
+        [str(w.message) for w in caught]
+    assert int(state.step) == 12, int(state.step)
+    return {"steps": {str(k): v for k, v in statuses.items()},
+            "resumed_from": steps[1], "reached": int(state.step)}
+
+
+def scenario_kill_save(tmp: str) -> dict:
+    """SIGKILL a training victim mid-checkpoint-save (grand-child
+    process, crash-only); resume from what survived — the newest step
+    that is committed and not provably corrupt — and reach the target.
+    """
+    env = dict(os.environ,
+               PERCEIVER_FAULTS="ckpt.kill_during_save@at=1",
+               PERCEIVER_TPU_OFFLINE="1")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--scenario",
+         "kill_save_victim", "--tmp", tmp],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stdout, proc.stderr)
+
+    from perceiver_tpu.training.checkpoint import CheckpointHook
+    log_root = os.path.join(tmp, "logs_killvictim", "default")
+    versions = sorted(os.listdir(log_root))
+    ckpt_dir = os.path.join(log_root, versions[-1], "checkpoints")
+    hook = CheckpointHook(ckpt_dir, monitor="")
+    steps = hook._steps()
+    assert steps, "victim died before any checkpoint committed"
+    survivor = hook._newest_restorable_step()
+    assert survivor is not None and hook.verify(survivor) != "corrupt"
+
+    resume = _make_trainer(tmp, "kill_resume", max_steps=survivor + 3,
+                           resume_from_checkpoint=ckpt_dir)
+    state = resume.fit()
+    assert int(state.step) == survivor + 3, int(state.step)
+    assert _finite(state)
+    return {"victim_rc": proc.returncode, "committed_steps": steps,
+            "resumed_from": survivor, "reached": int(state.step)}
+
+
+def scenario_kill_save_victim(tmp: str) -> dict:
+    """(grand-child) train with checkpointing until the armed
+    kill-during-save fault SIGKILLs this process."""
+    _checkpointed_run(tmp, "killvictim", max_steps=25)
+    raise AssertionError("victim survived its kill fault")
+
+
+def scenario_preempt(tmp: str) -> dict:
+    """An injected preemption notice saves full state to
+    checkpoints-preempt (manifest-sealed) and stops cleanly; resume
+    picks it up and reaches the target."""
+    from perceiver_tpu.training.checkpoint import CheckpointHook
+
+    trainer = _make_trainer(tmp, "preempt", max_steps=20)
+    trainer.fit()
+    stopped_at = trainer.global_step
+    assert 0 < stopped_at < 20, stopped_at
+    preempt_dir = os.path.join(trainer.log_dir, "checkpoints-preempt")
+    hook = CheckpointHook(preempt_dir, monitor="")
+    assert hook.verify(stopped_at) == "verified"
+
+    resume = _make_trainer(tmp, "preempt_resume",
+                           max_steps=stopped_at + 3,
+                           resume_from_checkpoint=preempt_dir)
+    state = resume.fit()
+    assert int(state.step) == stopped_at + 3, int(state.step)
+    return {"preempted_at": stopped_at, "reached": int(state.step)}
+
+
+def scenario_serve_dispatch(tmp: str) -> dict:
+    """Serve-dispatch failures: the batch fails with per-request typed
+    errors, the bucket's breaker opens (requests get typed Unavailable
+    without hanging), a half-open probe recovers it, and health walks
+    READY → UNAVAILABLE → READY. Zero unhandled exceptions."""
+    import numpy as np
+
+    from perceiver_tpu.serving import (
+        BatchError,
+        HealthState,
+        MicroBatcher,
+        ServingEngine,
+        Unavailable,
+        materialize,
+    )
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    task = MaskedLanguageModelTask(
+        vocab_size=128, max_seq_len=32, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+    engine = ServingEngine(task, batch_buckets=(1,), seq_buckets=(16,),
+                           breaker_failure_threshold=2,
+                           breaker_reset_s=0.25)
+    assert engine.health.state is HealthState.READY
+
+    def runner(payloads):
+        res = engine.dispatch(payloads[0])
+        return [materialize(res, engine.graph)]
+
+    batcher = MicroBatcher(runner, max_batch=1, max_delay_ms=0.5,
+                           metrics=engine.metrics)
+    rng = np.random.default_rng(0)
+    arrays = {"input_ids": rng.integers(3, 128, (1, 16)).astype(np.int32),
+              "pad_mask": np.zeros((1, 16), bool)}
+
+    counts = {"ok": 0, "batch_error": 0, "unavailable": 0}
+    states_seen = {engine.health.state}
+    first_failure_t = None
+    recovered_t = None
+    deadline = time.monotonic() + 30.0
+    try:
+        while time.monotonic() < deadline:
+            try:
+                out = batcher.submit(dict(arrays)).result(timeout=30)
+                assert "topk_ids" in out
+                counts["ok"] += 1
+                if first_failure_t is not None and recovered_t is None:
+                    recovered_t = time.monotonic()
+                if recovered_t is not None and counts["ok"] >= 3:
+                    break
+            except Unavailable:
+                counts["unavailable"] += 1
+                if first_failure_t is None:
+                    first_failure_t = time.monotonic()
+                time.sleep(0.05)
+            except BatchError:
+                counts["batch_error"] += 1
+                if first_failure_t is None:
+                    first_failure_t = time.monotonic()
+            states_seen.add(engine.health.state)
+    finally:
+        batcher.close()
+    states_seen.add(engine.health.state)
+
+    assert counts["batch_error"] >= 2, counts      # injected failures
+    assert counts["unavailable"] >= 1, counts      # breaker opened
+    assert recovered_t is not None, counts         # ...and recovered
+    assert engine.health.state is HealthState.READY
+    assert HealthState.UNAVAILABLE in states_seen  # sole bucket open
+    m = engine.metrics
+    assert m.get("serving_failed_batches_total").value >= 2
+    assert m.get("serving_unavailable_total").value >= 1
+    return {"requests": counts,
+            "recovery_s": round(recovered_t - first_failure_t, 4),
+            "health_states": sorted(s.name for s in states_seen),
+            "failed_batches":
+                m.get("serving_failed_batches_total").value}
+
+
+# scenario name -> (fault plan armed via PERCEIVER_FAULTS, fn)
+_SCENARIOS = {
+    "loader_crash": ("loader.exception@at=1,count=2",
+                     scenario_loader_crash),
+    "nan_skip": ("train.nonfinite@at=2,count=2", scenario_nan_skip),
+    "nan_rewind": ("train.nonfinite@at=3,count=5", scenario_nan_rewind),
+    "truncated_ckpt": ("ckpt.truncate@at=1", scenario_truncated_ckpt),
+    "kill_save": (None, scenario_kill_save),
+    "kill_save_victim": (None, scenario_kill_save_victim),  # internal
+    "preempt": ("train.preempt@at=3", scenario_preempt),
+    "serve_dispatch": ("serve.dispatch@at=1,count=4",
+                       scenario_serve_dispatch),
+}
+_MATRIX = ["loader_crash", "nan_skip", "nan_rewind", "truncated_ckpt",
+           "kill_save", "preempt", "serve_dispatch"]
+_FAST = ["nan_skip", "serve_dispatch"]
+
+
+def _run_child(name: str, tmp: str) -> dict:
+    plan, _ = _SCENARIOS[name]
+    env = dict(os.environ, PERCEIVER_TPU_OFFLINE="1")
+    env.pop("PERCEIVER_FAULTS", None)
+    if plan:
+        env["PERCEIVER_FAULTS"] = plan
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--scenario", name,
+         "--tmp", tmp],
+        env=env, capture_output=True, text=True, cwd=_REPO, timeout=900)
+    if proc.returncode != 0:
+        return {"survived": False,
+                "error": proc.stderr.strip().splitlines()[-12:]}
+    detail = json.loads(proc.stdout.strip().splitlines()[-1])
+    detail["survived"] = True
+    return detail
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="fault-matrix chaos runner")
+    ap.add_argument("--fast", action="store_true",
+                    help=f"tier-1 subset {_FAST} instead of the full "
+                         "matrix")
+    ap.add_argument("--only", nargs="*", default=None,
+                    help="run just these scenarios")
+    ap.add_argument("--out", default=None,
+                    help="also append the result lines to this path")
+    ap.add_argument("--scenario", default=None, choices=sorted(_SCENARIOS),
+                    help=argparse.SUPPRESS)  # internal: child mode
+    ap.add_argument("--tmp", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.scenario:
+        # child mode: the fault plan (if any) was armed from the env at
+        # import; run one scenario and emit its JSON detail
+        from perceiver_tpu.resilience import faults
+
+        detail = _SCENARIOS[args.scenario][1](args.tmp)
+        detail["faults_fired"] = faults.counts()
+        print(json.dumps(detail, default=str), flush=True)
+        return 0
+
+    names = args.only or (_FAST if args.fast else _MATRIX)
+    unknown = [n for n in names
+               if n not in _SCENARIOS or n == "kill_save_victim"]
+    if unknown:
+        ap.error(f"unknown scenario(s) {unknown}")
+    results, ok = [], True
+    for name in names:
+        print(f"[chaos] {name}: injecting "
+              f"{_SCENARIOS[name][0] or 'kill -9 (grand-child)'} ...",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        with tempfile.TemporaryDirectory(prefix=f"chaos-{name}-") as tmp:
+            detail = _run_child(name, tmp)
+        detail["wall_s"] = round(time.perf_counter() - t0, 2)
+        survived = detail.pop("survived")
+        ok = ok and survived
+        line = {"metric": f"chaos_{name}",
+                "value": 1.0 if survived else 0.0, "unit": "survived",
+                "vs_baseline": None, "detail": detail}
+        results.append(line)
+        print(json.dumps(line), flush=True)
+    summary = {"metric": "chaos_matrix",
+               "value": round(sum(r["value"] for r in results)
+                              / max(len(results), 1), 3),
+               "unit": "fraction_survived", "vs_baseline": None,
+               "detail": {"scenarios": len(results),
+                          "fast": bool(args.fast)}}
+    results.append(summary)
+    print(json.dumps(summary), flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            for line in results:
+                f.write(json.dumps(line) + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,11 @@ _SLOW = {
     "test_graphcheck.py::test_full_graph_sweep_is_clean",
     "test_graphcheck.py::test_full_lint_sweep_is_clean",
     "test_exec_cache.py::test_bench_startup_script_cold_warm",
+    "test_resilience.py::test_trainer_skip_policy_survives_isolated_nan_steps",
+    "test_resilience.py::test_trainer_streak_rewinds_from_verified_anchor",
+    "test_resilience.py::test_terminate_on_nan_names_first_bad_step_in_block",
+    "test_resilience.py::test_preemption_fault_roundtrip_with_verified_checkpoint",
+    "test_resilience.py::test_trainer_loader_crash_survived_by_supervisor",
 }
 
 

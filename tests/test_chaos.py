@@ -1,0 +1,33 @@
+"""``scripts/chaos.py --fast`` as a literal subprocess gate — the
+check.py pattern (ISSUE 5 satellite): the tier-1 suite proves a fresh
+process, armed only through the ``PERCEIVER_FAULTS`` env seam,
+survives its fault matrix subset and emits well-formed bench.py-format
+JSON."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_chaos_fast_matrix_survives():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "chaos.py"),
+         "--fast"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, f"\n{proc.stdout}\n{proc.stderr}"
+
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    by_metric = {ln["metric"]: ln for ln in lines}
+    # bench.py-format records, every scenario survived
+    for line in lines:
+        assert {"metric", "value", "unit", "vs_baseline",
+                "detail"} <= set(line)
+    assert by_metric["chaos_matrix"]["value"] == 1.0
+    scenarios = [ln for ln in lines if ln["metric"] != "chaos_matrix"]
+    assert len(scenarios) >= 2
+    assert all(ln["value"] == 1.0 for ln in scenarios)
+    # the faults really fired (survival by inertness doesn't count)
+    assert all(ln["detail"]["faults_fired"] for ln in scenarios)

@@ -671,6 +671,56 @@ def test_lint_clean_on_fixed_tree_files():
             assert not lint_source(f.read(), rel), rel
 
 
+# --- silent-swallow ---------------------------------------------------------
+
+_SWALLOW = """
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        pass
+"""
+
+_SWALLOW_BARE = """
+def load(path):
+    try:
+        return open(path).read()
+    except:
+        return None
+"""
+
+
+def test_lint_silent_swallow_seeded():
+    """Both shapes the rule exists for: except Exception: pass, and a
+    bare except (flagged regardless of body)."""
+    assert "silent-swallow" in _checks(_SWALLOW)
+    assert "silent-swallow" in _checks(_SWALLOW_BARE)
+    ellipsis = _SWALLOW.replace("pass", "...")
+    assert "silent-swallow" in _checks(ellipsis)
+    tupled = _SWALLOW.replace("except Exception:",
+                              "except (ValueError, Exception):")
+    assert "silent-swallow" in _checks(tupled)
+
+
+def test_lint_silent_swallow_reason_comment_clears():
+    reasoned = _SWALLOW.replace(
+        "pass", "pass  # probing an optional path — absence is fine")
+    assert "silent-swallow" not in _checks(reasoned)
+    on_except = _SWALLOW.replace(
+        "except Exception:",
+        "except Exception:  # noqa: BLE001 — fall through and rebuild")
+    assert "silent-swallow" not in _checks(on_except)
+    suppressed = _SWALLOW.replace("pass", "pass  # graphcheck: ignore")
+    assert "silent-swallow" not in _checks(suppressed)
+
+
+def test_lint_silent_swallow_ignores_narrow_and_visible():
+    narrow = _SWALLOW.replace("except Exception:", "except OSError:")
+    assert "silent-swallow" not in _checks(narrow)
+    visible = _SWALLOW.replace("pass", "return None")
+    assert "silent-swallow" not in _checks(visible)
+
+
 # --- uncached-compile -------------------------------------------------------
 
 _RAW_COMPILE_CHAINED = """

@@ -1,0 +1,764 @@
+"""Chaos tests for the resilience layer (ISSUE 5, docs/RESILIENCE.md).
+
+Every defense is pinned against its deterministic fault, in-process —
+plus the crash-only checkpoint contract in subprocess kill-during-save
+form: the run reaches its target step with verified-checkpoint
+restore, and serving keeps answering with typed errors only.
+"""
+
+import dataclasses
+import io
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from perceiver_tpu.data.core import ArrayDataset, BatchIterator
+from perceiver_tpu.data.prefetch import LoaderStalled, PrefetchIterator
+from perceiver_tpu.resilience import (
+    CircuitBreaker,
+    FaultInjected,
+    FaultPlan,
+    NonFiniteLossError,
+    StepGuard,
+    faults,
+)
+from perceiver_tpu.resilience import breaker as breaker_mod
+from perceiver_tpu.resilience import guard as guard_mod
+from perceiver_tpu.training.checkpoint import (
+    CORRUPT,
+    UNVERIFIED,
+    VERIFIED,
+    CheckpointHook,
+    CheckpointIntegrityError,
+    _truncate_one_blob,
+    restore_params,
+    verify_step,
+)
+from perceiver_tpu.training.state import TrainState
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No fault plan may leak between tests (module-global arming)."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# --- faults: the injection framework ----------------------------------------
+
+
+class TestFaultPlan:
+    def test_parse_and_window(self):
+        plan = FaultPlan.parse(
+            "train.nonfinite@at=2,count=3;serve.dispatch")
+        spec = plan.specs["train.nonfinite"]
+        assert (spec.at, spec.count) == (2, 3)
+        # occurrences 0,1 inert; 2,3,4 fire; 5+ inert again
+        fires = [plan.fire("train.nonfinite") is not None
+                 for _ in range(6)]
+        assert fires == [False, False, True, True, True, False]
+        # default window: first occurrence only
+        assert plan.fire("serve.dispatch") is not None
+        assert plan.fire("serve.dispatch") is None
+        assert plan.counts() == {"train.nonfinite": 3,
+                                 "serve.dispatch": 1}
+
+    def test_unknown_point_and_bad_params_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            FaultPlan.parse("loader.exploded")
+        with pytest.raises(ValueError, match="bad fault param"):
+            FaultPlan.parse("loader.exception@when=3")
+        with pytest.raises(ValueError, match="duplicate"):
+            FaultPlan.parse("serve.dispatch;serve.dispatch@at=1")
+        with pytest.raises(ValueError, match="empty"):
+            FaultPlan.parse("  ;  ")
+
+    def test_unarmed_is_inert(self):
+        assert faults.active() is None
+        assert not faults.fire("serve.dispatch")
+        assert not faults.armed("serve.dispatch")
+        faults.maybe_raise("serve.dispatch")  # no-op, no raise
+        assert faults.counts() == {}
+
+    def test_arm_disarm_and_maybe_raise(self):
+        faults.arm("serve.dispatch@count=2")
+        assert faults.armed("serve.dispatch")
+        with pytest.raises(FaultInjected, match="serve.dispatch"):
+            faults.maybe_raise("serve.dispatch")
+        with pytest.raises(FaultInjected):
+            faults.maybe_raise("serve.dispatch")
+        faults.maybe_raise("serve.dispatch")  # window spent
+        faults.disarm()
+        assert not faults.armed("serve.dispatch")
+
+    def test_forever_window(self):
+        plan = faults.arm("train.nonfinite@count=-1")
+        assert all(plan.fire("train.nonfinite") for _ in range(50))
+
+
+# --- circuit breaker --------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_full_state_machine(self):
+        now = [0.0]
+        seen = []
+        b = CircuitBreaker(failure_threshold=2, reset_timeout_s=5.0,
+                           clock=lambda: now[0],
+                           on_transition=lambda o, n: seen.append((o, n)))
+        assert b.allow() and b.state == breaker_mod.CLOSED
+        b.record_failure()
+        assert b.state == breaker_mod.CLOSED  # below threshold
+        b.record_failure()
+        assert b.state == breaker_mod.OPEN
+        assert not b.allow()
+        assert b.retry_after() == pytest.approx(5.0)
+        now[0] = 3.0
+        assert not b.allow() and b.retry_after() == pytest.approx(2.0)
+        now[0] = 5.5
+        assert b.allow()  # half-open probe
+        assert b.state == breaker_mod.HALF_OPEN
+        assert not b.allow()  # only one probe until its outcome lands
+        b.record_failure()  # failed probe
+        assert b.state == breaker_mod.OPEN
+        now[0] = 11.0
+        assert b.allow()
+        b.record_success()
+        assert b.state == breaker_mod.CLOSED and b.allow()
+        assert seen == [
+            (breaker_mod.CLOSED, breaker_mod.OPEN),
+            (breaker_mod.OPEN, breaker_mod.HALF_OPEN),
+            (breaker_mod.HALF_OPEN, breaker_mod.OPEN),
+            (breaker_mod.OPEN, breaker_mod.HALF_OPEN),
+            (breaker_mod.HALF_OPEN, breaker_mod.CLOSED),
+        ]
+
+    def test_success_resets_failure_streak(self):
+        b = CircuitBreaker(failure_threshold=2, reset_timeout_s=1.0)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == breaker_mod.CLOSED
+
+    def test_callback_may_read_state(self):
+        """Regression: on_transition fires outside the breaker lock, so
+        a metrics/health callback reading .state must not deadlock."""
+        states = []
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                           on_transition=lambda o, n:
+                           states.append(b.state))
+        b.record_failure()
+        assert states == [breaker_mod.OPEN]
+
+
+# --- step guard -------------------------------------------------------------
+
+
+class TestStepGuard:
+    def test_halt_names_exact_step_inside_block(self):
+        g = StepGuard(guard_mod.HALT)
+        assert g.observe([1.0, 0.5], first_step=10) == guard_mod.OK
+        with pytest.raises(NonFiniteLossError,
+                           match=r"step 14 \(terminate_on_nan\)"):
+            g.observe([0.4, np.nan, 0.3], first_step=12)
+
+    def test_skip_counts_and_streak_rewinds(self):
+        g = StepGuard(guard_mod.SKIP, streak_to_rewind=3, max_rewinds=1)
+        assert g.observe([np.nan, 1.0, np.inf], 0) == guard_mod.OK
+        assert g.skipped_total == 2  # isolated bads, streak broken
+        assert g.observe([np.nan, np.nan, np.nan], 3) == guard_mod.REWIND
+        assert g.rewinds == 1
+        # budget spent: the next streak halts with a typed error
+        with pytest.raises(NonFiniteLossError, match="rewind budget"):
+            g.observe([np.nan] * 3, 6)
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            StepGuard("never-heard-of-it")
+
+    def test_wrapped_step_skips_update_and_reports_loss(self):
+        """Device half: a non-finite loss leaves params/opt_state
+        untouched while rng/step advance; finite steps train."""
+        def train_step(state, batch):
+            grad = batch["x"].mean()
+            params = jax.tree.map(lambda p: p - 0.1 * grad, state.params)
+            rng, _ = jax.random.split(state.rng)
+            new = dataclasses.replace(state, params=params, rng=rng,
+                                      step=state.step + 1)
+            return new, {"loss": grad}
+
+        guarded = jax.jit(guard_mod.wrap_train_step(train_step))
+        params = {"w": jnp.ones((3,))}
+        tx = optax.sgd(0.1)
+        state = TrainState.create(params, tx.init(params),
+                                  jax.random.key(0))
+        good = {"x": jnp.full((4,), 2.0)}
+        bad = {"x": jnp.full((4,), jnp.nan)}
+
+        s1, m1, l1 = guarded(state, good)
+        assert np.isfinite(float(l1[0]))
+        np.testing.assert_allclose(np.asarray(s1.params["w"]), 0.8)
+        s2, m2, l2 = guarded(s1, bad)
+        assert not np.isfinite(float(l2[0]))
+        # skipped: params identical, but step and rng advanced
+        np.testing.assert_array_equal(np.asarray(s2.params["w"]),
+                                      np.asarray(s1.params["w"]))
+        assert int(s2.step) == int(s1.step) + 1
+        assert not np.array_equal(jax.random.key_data(s2.rng),
+                                  jax.random.key_data(s1.rng))
+
+    def test_wrapped_multi_threads_per_step_losses(self):
+        def train_step(state, batch):
+            loss = batch["x"].mean()
+            new = dataclasses.replace(
+                state,
+                params=jax.tree.map(lambda p: p - loss, state.params),
+                step=state.step + 1)
+            return new, {"loss": loss}
+
+        multi = jax.jit(guard_mod.wrap_train_step_multi(train_step))
+        params = {"w": jnp.zeros(())}
+        tx = optax.sgd(0.1)
+        state = TrainState.create(params, tx.init(params),
+                                  jax.random.key(0))
+        stacked = {"x": jnp.stack([jnp.full((2,), 1.0),
+                                   jnp.full((2,), jnp.nan),
+                                   jnp.full((2,), 3.0)])}
+        out, metrics, losses = multi(state, stacked)
+        got = np.asarray(losses)
+        assert got.shape == (3,)
+        assert np.isfinite(got[0]) and not np.isfinite(got[1]) \
+            and np.isfinite(got[2])
+        # only the two finite steps applied: 0 - 1 - 3 = -4
+        assert float(out.params["w"]) == pytest.approx(-4.0)
+        assert int(out.step) == 3
+
+
+# --- checkpoint integrity ---------------------------------------------------
+
+
+def _tiny_state(value: float = 1.0, step: int = 0) -> TrainState:
+    params = {"w": jnp.arange(8.0) * value, "b": jnp.ones((2,)) * value}
+    tx = optax.adamw(1e-3)
+    state = TrainState.create(params, tx.init(params), jax.random.key(3))
+    return dataclasses.replace(state, step=jnp.asarray(step))
+
+
+class TestCheckpointIntegrity:
+    def test_save_seals_verified_manifest(self, tmp_path):
+        hook = CheckpointHook(str(tmp_path / "ck"), monitor="")
+        hook.save(1, _tiny_state(1.0, 1), {})
+        hook.wait()
+        step_dir = str(tmp_path / "ck" / "1")
+        assert os.path.exists(os.path.join(step_dir,
+                                           "manifest.sha256.json"))
+        assert hook.verify(1) == VERIFIED
+
+    def test_truncated_blob_falls_back_to_verified(self, tmp_path):
+        hook = CheckpointHook(str(tmp_path / "ck"), monitor="",
+                              max_to_keep=3)
+        hook.save(1, _tiny_state(1.0, 1), {})
+        hook.save(2, _tiny_state(7.0, 2), {})
+        hook.wait()
+        _truncate_one_blob(str(tmp_path / "ck" / "2"))
+        assert hook.verify(2) == CORRUPT
+        with pytest.warns(UserWarning, match="manifest"):
+            got = hook.restore_latest(_tiny_state())
+        assert int(got.step) == 1  # newest VERIFIED, not newest
+        np.testing.assert_array_equal(np.asarray(got.params["w"]),
+                                      np.arange(8.0))
+
+    def test_all_corrupt_raises_typed_error(self, tmp_path):
+        hook = CheckpointHook(str(tmp_path / "ck"), monitor="")
+        hook.save(1, _tiny_state(), {})
+        hook.wait()
+        _truncate_one_blob(str(tmp_path / "ck" / "1"))
+        with pytest.raises(CheckpointIntegrityError), \
+                pytest.warns(UserWarning, match="manifest"):
+            hook.restore_latest(_tiny_state())
+        # NOT a ValueError/KeyError: the trainer's optimizer-mismatch
+        # degrade path must never catch corruption
+        assert not issubclass(CheckpointIntegrityError,
+                              (ValueError, KeyError))
+
+    def test_manifestless_step_is_legacy_restorable(self, tmp_path):
+        hook = CheckpointHook(str(tmp_path / "ck"), monitor="")
+        hook.save(1, _tiny_state(2.0, 1), {})
+        hook.wait()
+        os.unlink(str(tmp_path / "ck" / "1" / "manifest.sha256.json"))
+        assert hook.verify(1) == UNVERIFIED
+        got = hook.restore_latest(_tiny_state())
+        assert int(got.step) == 1
+
+    def test_restore_params_skips_corrupt_step(self, tmp_path):
+        hook = CheckpointHook(str(tmp_path / "ck"), monitor="",
+                              max_to_keep=3)
+        hook.save(1, _tiny_state(1.0, 1), {})
+        hook.save(2, _tiny_state(9.0, 2), {})
+        hook.wait()
+        _truncate_one_blob(str(tmp_path / "ck" / "2"))
+        with pytest.warns(UserWarning, match="corrupt"):
+            params = restore_params(str(tmp_path / "ck"))
+        np.testing.assert_array_equal(np.asarray(params["w"]),
+                                      np.arange(8.0))
+
+    def test_empty_dir_still_returns_none(self, tmp_path):
+        hook = CheckpointHook(str(tmp_path / "ck"), monitor="")
+        assert hook.restore_latest(_tiny_state()) is None
+
+    def test_kill_during_save_subprocess(self, tmp_path):
+        """Crash-only contract, proven with a real SIGKILL in a fresh
+        subprocess: the victim dies mid-save, the survivor steps are
+        restorable, and the restored values are bitwise-exact for
+        whichever step survived."""
+        script = textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {_REPO!r})
+            from tests.test_resilience import _tiny_state
+            from perceiver_tpu.training.checkpoint import CheckpointHook
+
+            hook = CheckpointHook({str(tmp_path / "ck")!r},
+                                  max_to_keep=5, monitor="")
+            hook.save(1, _tiny_state(1.0, 1), {{}})
+            hook.save(2, _tiny_state(3.0, 2), {{}})  # armed kill fires
+            hook.wait()
+            print("SURVIVED-THE-KILL")
+        """)
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     PERCEIVER_FAULTS="ckpt.kill_during_save@at=1"),
+            capture_output=True, text=True, timeout=300)
+        assert proc.returncode == -signal.SIGKILL, (proc.returncode,
+                                                    proc.stderr)
+        assert "SURVIVED-THE-KILL" not in proc.stdout
+
+        hook = CheckpointHook(str(tmp_path / "ck"), monitor="")
+        # save 1 was sealed before the kill — always verified
+        assert hook.verify(1) == VERIFIED
+        got = hook.restore_latest(_tiny_state())
+        assert got is not None
+        expect = {1: np.arange(8.0), 2: np.arange(8.0) * 3.0}
+        np.testing.assert_array_equal(np.asarray(got.params["w"]),
+                                      expect[int(got.step)])
+        # cleanup any partially-committed junk never breaks _steps()
+        assert all(isinstance(s, int) for s in hook._steps())
+
+    def test_truncate_fault_seam(self, tmp_path):
+        faults.arm("ckpt.truncate@at=0")
+        hook = CheckpointHook(str(tmp_path / "ck"), monitor="")
+        hook.save(1, _tiny_state(), {})
+        hook.wait()  # finalize seals the manifest, then the fault bites
+        assert hook.verify(1) == CORRUPT
+        assert verify_step(str(tmp_path / "ck" / "1")) == CORRUPT
+
+
+# --- supervised prefetch ----------------------------------------------------
+
+
+def _loader(n=23, bs=4):
+    ds = ArrayDataset(x=np.arange(n, dtype=np.int32))
+    return BatchIterator(ds, bs, shuffle=True, seed=5)
+
+
+class TestSupervisedPrefetch:
+    def test_transient_failure_restarts_with_identical_stream(self):
+        faults.arm("loader.exception@at=3,count=2")
+        pf = PrefetchIterator(_loader(), max_restarts=3, backoff_s=0.0)
+        got = [b["x"].copy() for b in pf]
+        want = [b["x"].copy() for b in _loader()]
+        assert pf.restarts == 2
+        assert len(got) == len(want)
+        for a, b in zip(got, want):
+            np.testing.assert_array_equal(a, b)  # no dups, no gaps
+
+    def test_poison_pill_budget_reraises(self):
+        faults.arm("loader.exception@at=1,count=-1")
+        pf = PrefetchIterator(_loader(), max_restarts=2, backoff_s=0.0)
+        with pytest.raises(FaultInjected):
+            list(pf)
+        assert pf.restarts == 2  # budget fully spent first
+
+    def test_generator_inner_never_restarts(self):
+        def gen():
+            yield {"x": np.zeros(2)}
+            raise RuntimeError("boom")
+
+        pf = PrefetchIterator(gen(), max_restarts=5, backoff_s=0.0)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(pf)
+        assert pf.restarts == 0
+
+    def test_stall_watchdog_restarts(self):
+        faults.arm("loader.stall@at=2,count=1,value=5.0")
+        pf = PrefetchIterator(_loader(), max_restarts=2, backoff_s=0.0,
+                              stall_timeout_s=0.4)
+        got = [b["x"].copy() for b in pf]
+        want = [b["x"].copy() for b in _loader()]
+        assert pf.restarts == 1
+        for a, b in zip(got, want):
+            np.testing.assert_array_equal(a, b)
+
+    def test_stall_without_budget_raises_typed(self):
+        faults.arm("loader.stall@at=0,count=1,value=5.0")
+        pf = PrefetchIterator(_loader(), max_restarts=0,
+                              stall_timeout_s=0.3)
+        with pytest.raises(LoaderStalled):
+            list(pf)
+
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError):
+            PrefetchIterator(_loader(), max_restarts=-1)
+        with pytest.raises(ValueError):
+            PrefetchIterator(_loader(), stall_timeout_s=0.0)
+
+
+# --- download retries -------------------------------------------------------
+
+
+class TestDownloadRetries:
+    def _fetch(self, monkeypatch, responses, **kwargs):
+        """Drive fetch() against a scripted urlopen: each entry is an
+        Exception to raise or bytes to serve."""
+        import urllib.request
+
+        from perceiver_tpu.data import download
+
+        monkeypatch.delenv("PERCEIVER_TPU_OFFLINE", raising=False)
+        download._failed_urls.clear()
+        calls = []
+
+        def fake_urlopen(url, timeout=None):
+            action = responses[min(len(calls), len(responses) - 1)]
+            calls.append(url)
+            if isinstance(action, Exception):
+                raise action
+            return io.BytesIO(action)
+
+        monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+        return calls, download.fetch("http://x.test/f",
+                                     kwargs.pop("dest"),
+                                     backoff_s=0.0, **kwargs)
+
+    def test_transient_error_retried_then_succeeds(self, tmp_path,
+                                                   monkeypatch):
+        dest = str(tmp_path / "out")
+        calls, ok = self._fetch(
+            monkeypatch, [OSError("reset"), OSError("reset"), b"payload"],
+            dest=dest, retries=3)
+        assert ok and len(calls) == 3
+        with open(dest, "rb") as f:
+            assert f.read() == b"payload"
+
+    def test_budget_exhausted_returns_false_once(self, tmp_path,
+                                                 monkeypatch):
+        from perceiver_tpu.data import download
+
+        dest = str(tmp_path / "out")
+        calls, ok = self._fetch(monkeypatch, [OSError("down")],
+                                dest=dest, retries=3)
+        assert not ok and len(calls) == 3
+        assert not os.path.exists(dest)
+        # the URL is poisoned for this process: no further attempts
+        assert not download.fetch("http://x.test/f", dest)
+        assert len(calls) == 3
+
+    def test_sha256_mismatch_retries_and_never_publishes(self, tmp_path,
+                                                         monkeypatch):
+        import hashlib
+
+        dest = str(tmp_path / "out")
+        good = hashlib.sha256(b"good").hexdigest()
+        calls, ok = self._fetch(monkeypatch, [b"evil", b"evil", b"good"],
+                                dest=dest, retries=3, sha256=good)
+        assert ok and len(calls) == 3
+        with open(dest, "rb") as f:
+            assert f.read() == b"good"
+        calls, ok = self._fetch(monkeypatch, [b"evil"], dest=dest + "2",
+                                retries=2, sha256=good)
+        assert not ok
+        assert not os.path.exists(dest + "2")  # corrupt never published
+
+
+# --- serving: breaker, typed errors, health ---------------------------------
+
+
+VOCAB = 110
+
+
+def _tiny_mlm_task():
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    return MaskedLanguageModelTask(
+        vocab_size=VOCAB, max_seq_len=32, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+
+
+def _request(batch=1, length=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(3, VOCAB,
+                                      (batch, length)).astype(np.int32),
+            "pad_mask": np.zeros((batch, length), bool)}
+
+
+@pytest.fixture()
+def clocked_engine():
+    """Warmed single-bucket engine with an injectable breaker clock."""
+    from perceiver_tpu.serving import ServingEngine
+
+    now = [0.0]
+    engine = ServingEngine(_tiny_mlm_task(), batch_buckets=(1,),
+                           seq_buckets=(16,),
+                           breaker_failure_threshold=2,
+                           breaker_reset_s=10.0,
+                           breaker_clock=lambda: now[0])
+    return engine, now
+
+
+class TestServingResilience:
+    def test_breaker_opens_unavailable_then_probe_recovers(
+            self, clocked_engine):
+        from perceiver_tpu.serving import HealthState, Unavailable
+
+        engine, now = clocked_engine
+        assert engine.health.state is HealthState.READY
+        engine.dispatch(_request())  # healthy baseline
+
+        faults.arm("serve.dispatch@at=0,count=3")
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                engine.dispatch(_request())
+        # threshold 2 reached: sole bucket open ⇒ UNAVAILABLE, and
+        # requests now fail fast with the typed error + retry hint
+        assert engine.health.state is HealthState.UNAVAILABLE
+        with pytest.raises(Unavailable) as exc:
+            engine.dispatch(_request())
+        assert exc.value.reason == "circuit_open"
+        assert exc.value.retry_after_s == pytest.approx(10.0)
+        assert not engine.health.ready
+
+        now[0] = 11.0  # cooldown over: half-open probe — fails (3rd)
+        with pytest.raises(FaultInjected):
+            engine.dispatch(_request())
+        with pytest.raises(Unavailable):
+            engine.dispatch(_request())
+
+        now[0] = 22.0  # next probe succeeds: recovery
+        res = engine.dispatch(_request())
+        assert res.batch == 1
+        assert engine.health.state is HealthState.READY
+        assert engine.health.ready
+
+        m = engine.metrics
+        assert m.get("serving_dispatch_failures_total").value == 3
+        assert m.get("serving_unavailable_total").value_of(
+            reason="circuit_open") == 2
+        t = m.get("serving_breaker_transitions_total")
+        assert t.value_of(bucket="b1_s16", to="open") == 2
+        assert t.value_of(bucket="b1_s16", to="closed") == 1
+
+    def test_request_too_large_does_not_trip_breaker(self,
+                                                     clocked_engine):
+        from perceiver_tpu.serving import RequestTooLarge
+
+        engine, _ = clocked_engine
+        with pytest.raises(RequestTooLarge):
+            engine.dispatch(_request(batch=2))
+        assert engine.metrics.get(
+            "serving_dispatch_failures_total").value == 0
+        engine.dispatch(_request())  # still serving
+
+    def test_batcher_isolates_batch_with_typed_per_request_errors(
+            self, clocked_engine):
+        from perceiver_tpu.serving import (
+            BatchError,
+            MicroBatcher,
+            materialize,
+        )
+
+        engine, _ = clocked_engine
+
+        def runner(payloads):
+            res = engine.dispatch(payloads[0])
+            return [materialize(res, engine.graph)]
+
+        batcher = MicroBatcher(runner, max_batch=1, max_delay_ms=0.5,
+                               metrics=engine.metrics)
+        try:
+            faults.arm("serve.dispatch@at=0,count=1")
+            fut = batcher.submit(_request())
+            with pytest.raises(BatchError) as exc:
+                fut.result(timeout=30)
+            assert isinstance(exc.value.cause, FaultInjected)
+            # worker survived: the next request is served normally
+            out = batcher.submit(_request()).result(timeout=30)
+            assert "topk_ids" in out
+            m = engine.metrics
+            assert m.get("serving_failed_batches_total").value == 1
+            assert m.get("serving_requests_total").value_of(
+                outcome="error") == 1
+            assert m.get("serving_requests_total").value_of(
+                outcome="ok") == 1
+        finally:
+            batcher.close()
+
+    def test_unavailable_passes_through_batcher_typed(self,
+                                                      clocked_engine):
+        from perceiver_tpu.serving import (
+            MicroBatcher,
+            Unavailable,
+            materialize,
+        )
+
+        engine, _ = clocked_engine
+        faults.arm("serve.dispatch@at=0,count=2")
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                engine.dispatch(_request())
+
+        def runner(payloads):
+            res = engine.dispatch(payloads[0])
+            return [materialize(res, engine.graph)]
+
+        batcher = MicroBatcher(runner, max_batch=1, max_delay_ms=0.5,
+                               metrics=engine.metrics)
+        try:
+            with pytest.raises(Unavailable):
+                batcher.submit(_request()).result(timeout=30)
+            assert engine.metrics.get("serving_requests_total").value_of(
+                outcome="unavailable") == 1
+        finally:
+            batcher.close()
+
+    def test_health_metrics_exported(self, clocked_engine):
+        engine, _ = clocked_engine
+        m = engine.metrics
+        assert m.get("serving_ready").value == 1
+        assert m.get("serving_health_state").value == 1  # READY
+        faults.arm("serve.dispatch@at=0,count=2")
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                engine.dispatch(_request())
+        assert m.get("serving_ready").value == 0
+        assert m.get("serving_health_state").value == 3  # UNAVAILABLE
+        trans = m.get("serving_health_transitions_total")
+        assert trans.value_of(**{"from": "ready",
+                                 "to": "unavailable"}) == 1
+
+
+# --- trainer end-to-end (slow) ----------------------------------------------
+
+
+def _trainer(tmp_path, tag, **overrides):
+    from perceiver_tpu.data import MNISTDataModule
+    from perceiver_tpu.training import Trainer, TrainerConfig
+
+    from tests.test_training import ADAMW, small_image_task
+
+    dm = MNISTDataModule(data_dir=str(tmp_path / "nope"), batch_size=16,
+                         synthetic_train_size=96, synthetic_test_size=32)
+    cfg = dict(max_steps=6, max_epochs=8, num_sanity_val_steps=0,
+               log_every_n_steps=1,
+               default_root_dir=str(tmp_path / f"logs_{tag}"),
+               enable_checkpointing=False, prefetch_batches=0)
+    cfg.update(overrides)
+    return Trainer(small_image_task(), dm, TrainerConfig(**cfg),
+                   optimizer_init=ADAMW)
+
+
+def _params_finite(state):
+    return all(bool(np.isfinite(np.asarray(leaf)).all())
+               for leaf in jax.tree.leaves(state.params))
+
+
+def test_trainer_skip_policy_survives_isolated_nan_steps(tmp_path):
+    """Two poisoned steps are skipped (no update applied), counted, and
+    the run reaches its target with finite params — the defense for
+    trainer.py's old one-bad-batch-kills-the-run mode."""
+    trainer = _trainer(tmp_path, "skip", nonfinite_policy="skip",
+                       fault_plan="train.nonfinite@at=2,count=2")
+    state = trainer.fit()
+    assert int(state.step) == 6
+    assert trainer._guard.skipped_total == 2
+    assert trainer._guard.rewinds == 0
+    assert _params_finite(state)
+
+
+def test_trainer_streak_rewinds_from_verified_anchor(tmp_path):
+    """A persistent bad window triggers anchor restore + deterministic
+    data replay; the run completes once the window passes."""
+    trainer = _trainer(tmp_path, "rewind", max_steps=8,
+                       nonfinite_policy="skip", nonfinite_streak=3,
+                       nonfinite_max_rewinds=2,
+                       fault_plan="train.nonfinite@at=3,count=5")
+    state = trainer.fit()
+    assert int(state.step) == 8
+    assert trainer._guard.rewinds >= 1
+    assert _params_finite(state)
+    # the anchor the rewind used is a sealed, verified checkpoint
+    guard_dir = os.path.join(trainer.log_dir, "checkpoints-guard")
+    hook = CheckpointHook(guard_dir, monitor="")
+    steps = hook._steps()
+    assert steps and hook.verify(steps[0]) == VERIFIED
+
+
+def test_terminate_on_nan_names_first_bad_step_in_block(tmp_path):
+    """Satellite: with steps_per_execution the halt names the exact
+    in-block step (previously only the block-boundary mean was seen)."""
+    trainer = _trainer(tmp_path, "halt", max_steps=9, max_epochs=3,
+                       steps_per_execution=3, log_every_n_steps=50,
+                       terminate_on_nan=True,
+                       fault_plan="train.nonfinite@at=4,count=1")
+    with pytest.raises(FloatingPointError,
+                       match=r"step 5 \(terminate_on_nan\)"):
+        trainer.fit()
+
+
+def test_preemption_fault_roundtrip_with_verified_checkpoint(tmp_path):
+    """The _handle_preemption path (trainer.py:378): injected
+    preemption → sealed save into checkpoints-preempt → clean stop →
+    resume_from_checkpoint continues to the target step."""
+    trainer = _trainer(tmp_path, "pre", max_steps=20,
+                       fault_plan="train.preempt@at=3")
+    trainer.fit()
+    stopped = trainer.global_step
+    assert 0 < stopped < 20
+    preempt_dir = os.path.join(trainer.log_dir, "checkpoints-preempt")
+    hook = CheckpointHook(preempt_dir, monitor="")
+    assert hook.verify(stopped) == VERIFIED
+
+    faults.disarm()
+    resume = _trainer(tmp_path, "pre2", max_steps=stopped + 2,
+                      resume_from_checkpoint=preempt_dir)
+    state = resume.fit()
+    assert int(state.step) == stopped + 2
+
+
+def test_trainer_loader_crash_survived_by_supervisor(tmp_path):
+    """Loader exceptions mid-epoch restart the prefetch producer; the
+    run reaches its target step (prefetch.py's old line-70 death)."""
+    trainer = _trainer(tmp_path, "loader", prefetch_batches=2,
+                       fault_plan="loader.exception@at=1,count=2")
+    state = trainer.fit()
+    assert int(state.step) == 6
+    assert _params_finite(state)
+
+
+def test_trainer_rejects_unknown_guard_policy(tmp_path):
+    with pytest.raises(ValueError, match="nonfinite_policy"):
+        _trainer(tmp_path, "bad", nonfinite_policy="retry-forever")
