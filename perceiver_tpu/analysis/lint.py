@@ -67,6 +67,17 @@ Rules (names are the ``check`` field of emitted violations):
     Scoped to the whole engine module on purpose: a sync in a helper
     called from dispatch stalls the pipeline exactly the same way.
 
+``router-blocking-io``
+    Blocking socket I/O without a deadline inside the fleet's
+    router/replica hot paths (modules under ``perceiver_tpu/fleet/``):
+    a ``.recv``/``.recv_into``/``.recvfrom``/``.accept`` call whose
+    receiver never gets a ``.settimeout(...)`` in the same module, or
+    a ``socket.create_connection`` without a ``timeout`` argument. A
+    bare blocking read turns one stalled replica into a hung router
+    thread — the failover contract (retry-on-sibling under a deadline,
+    docs/SERVING.md "Fleet") requires every socket operation to be
+    able to time out.
+
 Tracing detection is local and conservative: functions decorated with
 ``jax.jit`` / ``partial(jax.jit, ...)``, functions passed to a
 ``jax.jit(...)`` call anywhere in the module, and everything nested
@@ -487,6 +498,67 @@ def _check_engine_syncs(tree: ast.AST, imports: _Imports,
     return out
 
 
+# fleet/: every blocking socket op needs a reachable deadline
+_BLOCKING_RECV_ATTRS = {"recv", "recv_into", "recvfrom", "accept"}
+
+
+def _receiver_key(func: ast.AST) -> Optional[str]:
+    """``self._sock.recv`` → ``"self._sock"`` (the dotted receiver the
+    method is called on), None for non-name receivers."""
+    chain = _attr_chain(func)
+    return ".".join(chain[:-1]) if len(chain) >= 2 else None
+
+
+def _check_router_blocking_io(tree: ast.AST, path: str) -> List[Violation]:
+    """``router-blocking-io``: see the module docstring. The receiver
+    match is name-based and module-wide — one ``settimeout`` anywhere
+    on the same dotted receiver clears its reads, which is exactly the
+    discipline ``fleet/rpc.py`` follows (re-assert the timeout before
+    every framed read)."""
+    out: List[Violation] = []
+    with_timeout: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "settimeout":
+            key = _receiver_key(node.func)
+            if key is not None:
+                with_timeout.add(key)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _BLOCKING_RECV_ATTRS:
+            key = _receiver_key(func)
+            if key is not None and key not in with_timeout:
+                out.append(Violation(
+                    check="router-blocking-io",
+                    where=f"{path}:{node.lineno}",
+                    message=f"blocking {key}.{func.attr}() without a "
+                            f"settimeout on {key!r} anywhere in the "
+                            "module — a stalled peer would hang this "
+                            "fleet hot path forever; set a deadline "
+                            "so the router can eject and retry on a "
+                            "sibling"))
+            continue
+        chain = _attr_chain(func)
+        if chain and chain[-1] == "create_connection":
+            has_timeout = any(kw.arg == "timeout"
+                              for kw in node.keywords) \
+                or len(node.args) >= 2
+            if not has_timeout:
+                out.append(Violation(
+                    check="router-blocking-io",
+                    where=f"{path}:{node.lineno}",
+                    message="socket.create_connection without a "
+                            "timeout blocks indefinitely on an "
+                            "unresponsive replica — pass timeout= so "
+                            "connect attempts respect the fleet's "
+                            "failover deadline"))
+    return out
+
+
 def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     """Lint one module's source. ``path`` is used for reporting and
     for the ops-scoped rule (a path containing ``/ops/``)."""
@@ -499,6 +571,8 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     norm = path.replace(os.sep, "/")
     if norm.endswith("serving/engine.py"):
         violations.extend(_check_engine_syncs(tree, imports, path))
+    if "perceiver_tpu/fleet/" in norm:
+        violations.extend(_check_router_blocking_io(tree, path))
     if "perceiver_tpu/cache/" not in norm:
         violations.extend(_check_uncached_compiles(tree, path))
     if "/ops/" in norm and {"numpy", "jax.numpy"} <= imports.top_level:
@@ -552,7 +626,7 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
 
 ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
              "impl-field-validation", "serving-host-sync",
-             "uncached-compile", "silent-swallow")
+             "uncached-compile", "silent-swallow", "router-blocking-io")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

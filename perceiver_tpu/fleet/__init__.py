@@ -1,0 +1,44 @@
+"""Horizontal serving fleet (docs/SERVING.md "Fleet").
+
+A local process group of serving replicas behind a health-routed
+load balancer:
+
+- :class:`~perceiver_tpu.fleet.router.Router` — health/occupancy
+  routing, transparent retry-on-sibling, replica ejection via
+  circuit breakers;
+- :class:`~perceiver_tpu.fleet.supervisor.Supervisor` /
+  :class:`~perceiver_tpu.fleet.supervisor.Fleet` — replica process
+  lifecycle, crash restarts with backoff, the user-facing facade;
+- :class:`~perceiver_tpu.fleet.autoscaler.Autoscaler` — bounded
+  occupancy-driven scale up/down;
+- :func:`~perceiver_tpu.fleet.rollout.rolling_update` — zero-downtime
+  versioned param rollouts with auto-rollback;
+- ``perceiver_tpu.fleet.replica`` — the replica process entry point.
+"""
+
+from perceiver_tpu.fleet.autoscaler import Autoscaler
+from perceiver_tpu.fleet.rollout import RolloutAborted, rolling_update
+from perceiver_tpu.fleet.router import Router
+from perceiver_tpu.fleet.rpc import RpcClient, RpcError, RpcServer
+from perceiver_tpu.fleet.supervisor import (
+    Fleet,
+    ReplicaProcess,
+    ReplicaSpawnError,
+    RpcReplicaHandle,
+    Supervisor,
+)
+
+__all__ = [
+    "Autoscaler",
+    "Fleet",
+    "ReplicaProcess",
+    "ReplicaSpawnError",
+    "RolloutAborted",
+    "Router",
+    "RpcClient",
+    "RpcError",
+    "RpcReplicaHandle",
+    "RpcServer",
+    "Supervisor",
+    "rolling_update",
+]

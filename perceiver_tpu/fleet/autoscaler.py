@@ -1,0 +1,83 @@
+"""Occupancy-driven autoscaler for the serving fleet.
+
+Deterministic by construction: no timer thread — the owner calls
+``tick()`` (tests drive ticks directly; a deployment loop calls it on
+its own cadence). Each tick samples the router's mean in-flight per
+replica and applies hysteresis: only ``consecutive`` samples past a
+threshold trigger a resize, and every resize resets the streak — so a
+single bursty sample never flaps the fleet. Bounds are hard:
+``min_replicas <= size <= max_replicas`` always (docs/SERVING.md
+"Fleet").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Autoscaler:
+    """Scale a :class:`~perceiver_tpu.fleet.supervisor.Fleet` (or any
+    object with ``size()``/``scale_to(n)``/``router.occupancy()``)
+    between ``min_replicas`` and ``max_replicas``."""
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 scale_up_above: float = 1.5,
+                 scale_down_below: float = 0.25,
+                 consecutive: int = 3):
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}..{max_replicas}")
+        if scale_down_below >= scale_up_above:
+            raise ValueError("scale_down_below must sit strictly under "
+                             "scale_up_above (hysteresis band)")
+        if consecutive < 1:
+            raise ValueError("consecutive must be >= 1")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.scale_up_above = scale_up_above
+        self.scale_down_below = scale_down_below
+        self.consecutive = consecutive
+        self._fleet = None
+        self._up_streak = 0
+        self._down_streak = 0
+        self.resizes: list = []  # (direction, new_size) audit trail
+
+    def bind(self, fleet) -> None:
+        self._fleet = fleet
+
+    def tick(self) -> Optional[int]:
+        """Sample once; returns the new size if this tick resized,
+        else None. Enforces the min bound even without load (a fleet
+        below ``min_replicas`` — e.g. poisoned slots — scales up)."""
+        fleet = self._fleet
+        if fleet is None:
+            raise RuntimeError("autoscaler not bound to a fleet")
+        size = fleet.size()
+        if size < self.min_replicas:
+            fleet.scale_to(self.min_replicas)
+            self._up_streak = self._down_streak = 0
+            self.resizes.append(("up", self.min_replicas))
+            return self.min_replicas
+        occupancy = fleet.router.occupancy()
+        if occupancy > self.scale_up_above:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif occupancy < self.scale_down_below:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+        if self._up_streak >= self.consecutive \
+                and size < self.max_replicas:
+            self._up_streak = self._down_streak = 0
+            fleet.scale_to(size + 1)
+            self.resizes.append(("up", size + 1))
+            return size + 1
+        if self._down_streak >= self.consecutive \
+                and size > self.min_replicas:
+            self._up_streak = self._down_streak = 0
+            fleet.scale_to(size - 1)
+            self.resizes.append(("down", size - 1))
+            return size - 1
+        return None

@@ -1,0 +1,232 @@
+"""One fleet replica: a ServingEngine behind an RPC server.
+
+``python -m perceiver_tpu.fleet.replica --spec spec.json`` builds the
+task named in the spec, loads its params from a
+:class:`~perceiver_tpu.training.checkpoint.ParamsVersionStore` version
+(sha256-verified) or fresh-init, warms the engine's AOT buckets (a
+warm persistent exec cache makes this **zero-compile** — the PR-4
+unlock that makes replica spin-up cheap), then prints ``READY <port>``
+on stdout so the supervisor can connect.
+
+RPC ops (see ``fleet/rpc.py`` for the envelope):
+
+``dispatch``        host arrays in, materialized host outputs out
+``status``          health/readiness, in-flight, version, compile
+                    count, breaker summary, fired fault counts
+``update_version``  the rolling-update cutover (below)
+``metrics``         Prometheus text exposition
+``ping``            liveness no-op
+``shutdown``        clean exit
+
+The cutover guard is the replica-side half of the zero-downtime
+protocol (docs/SERVING.md "Fleet"): ``update_version`` flips a
+``_swapping`` flag (new dispatches are rejected with a typed
+``Unavailable("updating")`` the router transparently retries on a
+sibling), waits for in-flight dispatches to reach zero, verifies the
+target version's manifest, swaps via the engine's recompile-free
+``update_params``, then readmits traffic — so **no request is ever
+served by a mid-swap replica**: every dispatch runs entirely on the
+old params or entirely on the new.
+
+Chaos seams: ``replica.stall`` and ``replica.crash``
+(``resilience/faults.py``) fire in the dispatch handler, inherited by
+this process through the ``PERCEIVER_FAULTS`` env var exactly like
+every other chaos child.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from typing import Optional
+
+from perceiver_tpu.fleet.rpc import RpcServer
+from perceiver_tpu.resilience import faults
+from perceiver_tpu.serving.api import materialize
+from perceiver_tpu.serving.errors import Unavailable
+
+
+def build_task(spec: dict):
+    """Instantiate the spec's task config by class name from
+    ``perceiver_tpu.tasks`` (specs are JSON, so the task rides as
+    ``{"task_class": ..., "task_kwargs": {...}}``)."""
+    import perceiver_tpu.tasks as tasks
+
+    cls = getattr(tasks, spec["task_class"], None)
+    if cls is None:
+        raise ValueError(f"unknown task class {spec['task_class']!r}")
+    return cls(**spec.get("task_kwargs", {}))
+
+
+class ReplicaServer:
+    """Engine + RPC plumbing + the cutover guard for one replica."""
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._inflight = 0
+        self._swapping = False
+        self._stop = threading.Event()
+        self._compile_events: list = []
+        self._listener_registered = False
+        self._register_compile_listener()
+
+        from perceiver_tpu.serving.engine import ServingEngine
+
+        self.version: Optional[str] = spec.get("version")
+        self.store = None
+        params = None
+        task = build_task(spec)
+        if spec.get("store_dir"):
+            from perceiver_tpu.training.checkpoint import ParamsVersionStore
+
+            self.store = ParamsVersionStore(spec["store_dir"])
+            if self.version is None:
+                self.version = self.store.current()
+            if self.version is not None:
+                # template-less restore (orbax falls back to on-disk
+                # metadata): building an init-params template would
+                # compile the random init and break the zero-compile
+                # spin-up contract the fleet chaos gate asserts
+                params = self.store.load(self.version, None)
+        self.engine = ServingEngine(
+            task, params,
+            batch_buckets=tuple(spec.get("batch_buckets", (4,))),
+            seq_buckets=tuple(spec.get("seq_buckets", (16,))),
+            breaker_failure_threshold=spec.get(
+                "breaker_failure_threshold", 5),
+            breaker_reset_s=spec.get("breaker_reset_s", 30.0))
+        self.server = RpcServer(self.handle,
+                                port=int(spec.get("port", 0)),
+                                io_timeout=spec.get("io_timeout_s", 60.0))
+
+    def _register_compile_listener(self) -> None:
+        """Count XLA compile events from before engine construction —
+        the fleet's zero-compile-spin-up assertion reads this count
+        over RPC (``status``)."""
+        try:
+            import jax
+
+            def listener(name, **kwargs):
+                if "compile" in name:
+                    self._compile_events.append(name)
+
+            jax.monitoring.register_event_listener(listener)
+            self._listener_registered = True
+        except Exception:  # pragma: no cover - jax.monitoring drift
+            # older/newer jax without the listener API: the compile
+            # count degrades to unknown (-1) rather than blocking spin-up
+            self._compile_events = None
+
+    # -- RPC handler ------------------------------------------------------
+
+    def handle(self, request: dict):
+        op = request.get("op")
+        if op == "dispatch":
+            return self._dispatch(request["arrays"])
+        if op == "status":
+            return self._status()
+        if op == "update_version":
+            return self._update_version(request["version"])
+        if op == "metrics":
+            return self.engine.metrics.render()
+        if op == "ping":
+            return "pong"
+        if op == "shutdown":
+            self._stop.set()
+            return "bye"
+        raise ValueError(f"unknown op {op!r}")
+
+    def _dispatch(self, arrays: dict) -> dict:
+        with self._lock:
+            if self._swapping:
+                # mid-swap: typed rejection the router retries on a
+                # sibling — this replica serves no request until the
+                # param cutover completes
+                raise Unavailable("updating", retry_after_s=0.05)
+            self._inflight += 1
+        try:
+            faults.maybe_stall("replica.stall")
+            faults.maybe_kill("replica.crash")
+            result = self.engine.dispatch(arrays)
+            outputs = materialize(result, self.engine.graph)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._idle.notify_all()
+        return {"outputs": outputs,
+                "health": self.engine.health.state.name,
+                "version": self.version}
+
+    def _status(self) -> dict:
+        metrics = self.engine.metrics
+        open_buckets = metrics.get("serving_breaker_open_buckets")
+        with self._lock:
+            inflight = self._inflight
+            swapping = self._swapping
+        return {
+            "health": self.engine.health.state.name,
+            "ready": self.engine.ready and not swapping,
+            "inflight": inflight,
+            "swapping": swapping,
+            "version": self.version,
+            "compile_events": (len(self._compile_events)
+                               if self._compile_events is not None else -1),
+            "breaker_open_buckets": (int(open_buckets.value)
+                                     if open_buckets else 0),
+            "faults_fired": faults.counts(),
+        }
+
+    def _update_version(self, version: str) -> dict:
+        """The cutover: quiesce → verify → swap → readmit."""
+        with self._lock:
+            if self._swapping:
+                raise Unavailable("updating", retry_after_s=0.1)
+            self._swapping = True
+        try:
+            with self._lock:
+                while self._inflight > 0:
+                    self._idle.wait(0.05)
+            if self.store is None:
+                raise ValueError("replica has no params version store")
+            # verified load: raises CheckpointIntegrityError on a
+            # corrupt manifest — crosses the wire typed, and the
+            # rollout driver turns it into an auto-rollback
+            params = self.store.load(version,
+                                     self.engine._params_src)
+            self.engine.update_params(params)
+            self.version = version
+        finally:
+            with self._lock:
+                self._swapping = False
+        return {"version": self.version}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        print(f"READY {self.server.port}", flush=True)
+        self._stop.wait()
+        self.server.close()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.server.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="fleet replica process")
+    ap.add_argument("--spec", required=True,
+                    help="path to the replica spec JSON")
+    args = ap.parse_args(argv)
+    with open(args.spec) as f:
+        spec = json.load(f)
+    replica = ReplicaServer(spec)
+    replica.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Zero-downtime rolling param updates with auto-rollback.
+
+The cutover rides two existing seams: the engine's recompile-free
+``update_params`` (same shapes → same executables, so a version swap
+costs a ``device_put``, not a compile) and the sha256-sealed
+:class:`~perceiver_tpu.training.checkpoint.ParamsVersionStore` (a
+replica refuses to load a version whose manifest check fails).
+
+Per replica, in order (docs/SERVING.md "Fleet"):
+
+1. ``router.drain(rid)`` — no new traffic routes to the replica;
+2. ``router.wait_idle(rid)`` — router-side in-flight reaches zero;
+3. ``handle.update_version(v)`` — the replica quiesces its own
+   in-flight dispatches, verifies ``v``'s manifest, swaps params
+   (requests racing the swap get a typed ``Unavailable("updating")``
+   that the router retries on a sibling — no request is ever served
+   mid-swap);
+4. ``router.undrain(rid)`` — traffic returns, now on the new version.
+
+Failure at any replica triggers **auto-rollback**: the failing replica
+is undrained (it still serves the old version), every
+already-updated replica is rolled back to the previous version through
+the same drain/cutover steps, the store's CURRENT pointer is left
+untouched, and a typed :class:`RolloutAborted` reports both the cause
+and the rollback outcome. Mid-rollout checkpoint corruption is chaos-
+gated (``scripts/chaos.py --fleet``, scenario ``fleet_rollout_corrupt``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class RolloutAborted(RuntimeError):
+    """The rolling update failed and was rolled back.
+
+    ``cause`` is the replica-side failure; ``rolled_back`` lists the
+    replicas restored to the previous version; ``rollback_failed``
+    lists any that could not be restored (fleet left mixed — the
+    supervisor's restart path will converge them)."""
+
+    def __init__(self, message: str, cause: Exception,
+                 rolled_back, rollback_failed):
+        super().__init__(message)
+        self.cause = cause
+        self.rolled_back = list(rolled_back)
+        self.rollback_failed = list(rollback_failed)
+
+
+def _cutover(fleet, rid: str, version: str, *,
+             drain_timeout_s: float) -> None:
+    """Steps 1-4 for one replica; raises on verification/swap failure
+    with the replica undrained (it still serves its old version)."""
+    fleet.router.drain(rid)
+    try:
+        fleet.router.wait_idle(rid, timeout=drain_timeout_s)
+        handle = fleet.supervisor.handle_of(rid)
+        if handle is None:
+            raise RuntimeError(f"replica {rid} vanished mid-rollout")
+        handle.update_version(version)
+    finally:
+        fleet.router.undrain(rid)
+
+
+def rolling_update(fleet, version: str, *,
+                   drain_timeout_s: float = 10.0,
+                   on_replica_updated: Optional[Callable] = None) -> dict:
+    """Update every replica to ``version``, one at a time. Returns a
+    summary dict; raises :class:`RolloutAborted` (after rollback) on
+    failure. ``on_replica_updated(rid)`` fires after each successful
+    cutover — the chaos harness uses it to corrupt the new version
+    mid-rollout and assert the rollback path.
+    """
+    store = fleet.spec.get("store_dir")
+    if not store:
+        raise ValueError("rolling_update needs a fleet spec with a "
+                         "params version store (store_dir)")
+    from perceiver_tpu.training.checkpoint import ParamsVersionStore
+
+    store = ParamsVersionStore(fleet.spec["store_dir"])
+    previous = store.current()
+    order = fleet.supervisor.replicas()
+    updated = []
+    for rid in order:
+        try:
+            _cutover(fleet, rid, version,
+                     drain_timeout_s=drain_timeout_s)
+        except Exception as cause:  # noqa: BLE001 — typed re-raise below
+            rolled_back, failed = [], []
+            for done in updated:
+                if previous is None:
+                    failed.append(done)
+                    continue
+                try:
+                    _cutover(fleet, done, previous,
+                             drain_timeout_s=drain_timeout_s)
+                    rolled_back.append(done)
+                except Exception:  # noqa: BLE001 — collected, reported
+                    failed.append(done)
+            raise RolloutAborted(
+                f"rollout of {version!r} aborted at replica {rid} "
+                f"({type(cause).__name__}: {cause}); rolled back "
+                f"{rolled_back or 'nothing'}"
+                + (f", rollback FAILED for {failed}" if failed else ""),
+                cause, rolled_back, failed) from cause
+        updated.append(rid)
+        if on_replica_updated is not None:
+            on_replica_updated(rid)
+    # all replicas cut over — only now does CURRENT move, so a crash
+    # anywhere above leaves the store pointing at the old version
+    store.set_current(version)
+    fleet.spec["version"] = version
+    fleet.supervisor.spec["version"] = version
+    return {"version": version, "previous": previous,
+            "replicas": order, "updated": len(updated)}

@@ -1,0 +1,290 @@
+"""Health-routed load balancer over fleet replicas.
+
+The router is the fleet's single client-facing entry: ``submit``
+picks a replica using its *health signals* (the replica's exported
+health state, its current in-flight count, and a per-replica circuit
+breaker owned by the router), dispatches, and transparently retries
+recoverable failures on a sibling — so the caller's contract stays
+the single-engine contract: a result, or a typed ``ServingError``.
+Never a hang, never a dropped request (chaos-gated by
+``scripts/chaos.py --fleet``).
+
+Routing policy (docs/SERVING.md "Fleet"):
+
+- candidates are replicas that are not draining, whose router-side
+  breaker ``allow()``s traffic, and that were not already tried for
+  this request;
+- READY replicas are preferred over DEGRADED ones (a DEGRADED replica
+  serves, but only when nothing healthier is idle); ties break to the
+  lowest in-flight count (least-loaded);
+- transport failures (``RpcError``: connection refused/reset, recv
+  deadline on a stalled replica) record a breaker failure — repeated
+  failures **eject** the replica (breaker OPEN) until a half-open
+  probe (the background prober, or a later submit) readmits it;
+- typed ``Unavailable`` from a replica (mid-swap ``updating``, open
+  bucket breaker) excludes the replica for this request and retries a
+  sibling without ejecting anyone;
+- ``RequestTooLarge`` is deterministic — re-raised immediately, never
+  retried;
+- only when no candidate remains (every replica draining, ejected, or
+  already tried) does the caller see ``Unavailable("fleet_saturated")``
+  with a ``retry_after_s`` hint derived from the soonest breaker
+  reopen.
+
+Idempotency note: a retry after a transport error can re-execute a
+dispatch whose first attempt actually completed server-side. Fleet
+dispatch is pure inference (no server-side state mutation), so
+at-least-once execution is safe and exactly-once *delivery* is what
+the router guarantees.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from perceiver_tpu.fleet.rpc import RpcError
+from perceiver_tpu.resilience.breaker import CLOSED, OPEN, CircuitBreaker
+from perceiver_tpu.serving.errors import Unavailable
+from perceiver_tpu.serving.metrics import MetricsRegistry
+
+_HEALTH_RANK = {"READY": 0, "DEGRADED": 1, "STARTING": 2,
+                "UNAVAILABLE": 3}
+
+
+class _ReplicaState:
+    """Router-side book-keeping for one replica."""
+
+    def __init__(self, rid: str, handle, breaker: CircuitBreaker):
+        self.rid = rid
+        self.handle = handle
+        self.breaker = breaker
+        self.inflight = 0
+        self.draining = False
+        self.health = "READY"
+
+
+class Router:
+    """Load-balance ``submit`` calls over replica handles.
+
+    A *handle* needs ``dispatch(arrays) -> {"outputs", "health", ...}``
+    and ``status() -> dict`` (see :class:`fleet.supervisor.
+    RpcReplicaHandle`); tests pass fakes.
+    """
+
+    def __init__(self, *, max_attempts: int = 4,
+                 retry_backoff_s: float = 0.02,
+                 breaker_failure_threshold: int = 3,
+                 breaker_reset_s: float = 1.0,
+                 prober_interval_s: Optional[float] = 0.25,
+                 metrics: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = max_attempts
+        self.retry_backoff_s = retry_backoff_s
+        self._breaker_failure_threshold = breaker_failure_threshold
+        self._breaker_reset_s = breaker_reset_s
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _ReplicaState] = {}
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        m = self.metrics
+        self._m_requests = m.counter(
+            "fleet_requests_total",
+            "router submits, by outcome (ok|unavailable|error)")
+        self._m_retries = m.counter(
+            "fleet_retries_total",
+            "dispatch attempts retried on a sibling, by cause")
+        self._m_size = m.gauge("fleet_size", "replicas known to the router")
+        self._m_ejected = m.counter(
+            "fleet_ejections_total",
+            "replica ejections (router breaker opened)")
+        self._m_inflight = m.gauge(
+            "fleet_replica_inflight", "router-side in-flight per replica")
+        self._closed = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        if prober_interval_s:
+            self._prober = threading.Thread(
+                target=self._probe_loop, args=(prober_interval_s,),
+                name="fleet-prober", daemon=True)
+            self._prober.start()
+
+    # -- membership -------------------------------------------------------
+
+    def add(self, rid: str, handle) -> None:
+        breaker = CircuitBreaker(
+            failure_threshold=self._breaker_failure_threshold,
+            reset_timeout_s=self._breaker_reset_s,
+            clock=self._clock,
+            on_transition=lambda old, new: self._on_transition(new))
+        with self._lock:
+            self._replicas[rid] = _ReplicaState(rid, handle, breaker)
+            self._m_size.set(len(self._replicas))
+
+    def _on_transition(self, new: str) -> None:
+        if new == OPEN:
+            self._m_ejected.inc()
+
+    def remove(self, rid: str) -> None:
+        with self._lock:
+            self._replicas.pop(rid, None)
+            self._m_size.set(len(self._replicas))
+        self._m_inflight.labels(replica=rid).remove()
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def drain(self, rid: str) -> None:
+        """Stop routing new requests to ``rid`` (existing in-flight
+        requests finish normally)."""
+        with self._lock:
+            if rid in self._replicas:
+                self._replicas[rid].draining = True
+
+    def undrain(self, rid: str) -> None:
+        with self._lock:
+            if rid in self._replicas:
+                self._replicas[rid].draining = False
+
+    def wait_idle(self, rid: str, timeout: float = 10.0) -> bool:
+        """Block until the router has no in-flight request on ``rid``
+        (drain first, or this may never converge)."""
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            with self._lock:
+                state = self._replicas.get(rid)
+                if state is None or state.inflight == 0:
+                    return True
+            self._sleep(0.01)
+        return False
+
+    # -- routing ----------------------------------------------------------
+
+    def _pick(self, exclude) -> Optional[_ReplicaState]:
+        key = lambda r: (_HEALTH_RANK.get(r.health, 3),  # noqa: E731
+                         r.inflight, r.rid)
+        with self._lock:
+            avail = [r for r in self._replicas.values()
+                     if r.rid not in exclude and not r.draining
+                     and _HEALTH_RANK.get(r.health, 3) <= 1]
+            pool = [r for r in avail if r.breaker.state == CLOSED]
+            best = min(pool, key=key) if pool else None
+            if best is None:
+                # no healthy replica: offer ONE ejected replica its
+                # half-open probe (allow() consumes the probe token,
+                # so only call it on the replica actually dispatched)
+                for r in sorted(avail, key=key):
+                    if r.breaker.allow():
+                        best = r
+                        break
+            if best is None:
+                return None
+            best.inflight += 1
+            self._m_inflight.labels(replica=best.rid).set(best.inflight)
+            return best
+
+    def _release(self, state: _ReplicaState) -> None:
+        with self._lock:
+            state.inflight = max(0, state.inflight - 1)
+            self._m_inflight.labels(replica=state.rid).set(state.inflight)
+
+    def _retry_after_hint(self) -> float:
+        with self._lock:
+            hints = [r.breaker.retry_after()
+                     for r in self._replicas.values()]
+        open_hints = [h for h in hints if h > 0]
+        return min(open_hints) if open_hints else 0.1
+
+    def submit(self, arrays: dict) -> dict:
+        """Dispatch one request; returns the replica's materialized
+        outputs dict. Raises only typed serving errors."""
+        exclude: set = set()
+        last_unavailable: Optional[Unavailable] = None
+        for attempt in range(self.max_attempts):
+            state = self._pick(exclude)
+            if state is None:
+                if attempt + 1 >= self.max_attempts:
+                    break
+                # transient no-candidate (e.g. every replica tried once
+                # while one was mid-swap): back off and retry the full
+                # pool before declaring the fleet saturated
+                self._sleep(self.retry_backoff_s * (attempt + 1))
+                exclude.clear()
+                continue
+            try:
+                reply = state.handle.dispatch(arrays)
+            except RpcError:
+                self._release(state)
+                state.breaker.record_failure()
+                exclude.add(state.rid)
+                self._m_retries.labels(cause="transport").inc()
+                self._sleep(self.retry_backoff_s * (attempt + 1))
+                continue
+            except Unavailable as e:
+                self._release(state)
+                # replica-refused (mid-swap, open bucket breaker):
+                # typed and immediate — try a sibling, no ejection
+                last_unavailable = e
+                exclude.add(state.rid)
+                self._m_retries.labels(cause="unavailable").inc()
+                continue
+            except Exception:
+                self._release(state)
+                state.breaker.record_failure()
+                self._m_requests.labels(outcome="error").inc()
+                raise
+            self._release(state)
+            state.breaker.record_success()
+            state.health = reply.get("health", state.health)
+            self._m_requests.labels(outcome="ok").inc()
+            return reply
+        self._m_requests.labels(outcome="unavailable").inc()
+        retry_after = self._retry_after_hint()
+        if last_unavailable is not None:
+            retry_after = max(retry_after,
+                              last_unavailable.retry_after_s)
+        raise Unavailable("fleet_saturated", retry_after_s=retry_after)
+
+    def occupancy(self) -> float:
+        """Mean router-side in-flight per routable replica — the
+        autoscaler's input signal."""
+        with self._lock:
+            live = [r for r in self._replicas.values() if not r.draining]
+            if not live:
+                return 0.0
+            return sum(r.inflight for r in live) / len(live)
+
+    # -- background probing -----------------------------------------------
+
+    def _probe_loop(self, interval: float) -> None:
+        """Refresh replica health; record failures for unreachable
+        replicas so ejection does not have to wait for live traffic.
+        Deliberately never records *success*: a replica whose control
+        plane answers can still have a stalled dispatch path, so
+        readmission only happens through a successful real dispatch
+        (the half-open traffic probe in ``_pick``)."""
+        while not self._closed.wait(interval):
+            with self._lock:
+                states = list(self._replicas.values())
+            for state in states:
+                try:
+                    status = state.handle.status()
+                except (RpcError, Unavailable):
+                    # probe failure feeds the breaker like traffic
+                    # would, but costs no user request
+                    if state.breaker.state == CLOSED:
+                        state.breaker.record_failure()
+                    continue
+                except Exception:  # pragma: no cover - handle bug
+                    continue  # graphcheck: ignore — prober must not die
+                state.health = status.get("health", state.health)
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._prober is not None:
+            self._prober.join(2.0)

@@ -1,0 +1,267 @@
+"""Framed-pickle RPC over localhost TCP for the serving fleet.
+
+The fleet is a *local* process group (one host, N replica processes —
+docs/SERVING.md "Fleet"), so the transport is deliberately minimal:
+length-prefixed pickles over loopback TCP. What it is strict about is
+the two properties the router depends on:
+
+- **Every socket operation has a deadline.** A stalled replica must
+  surface as a ``socket.timeout`` the router can convert into a
+  retry-on-sibling, never as a hung router thread. ``recv_msg``
+  re-asserts the timeout on the socket before reading, and the
+  ``router-blocking-io`` lint rule (``analysis/lint.py``) rejects any
+  bare ``recv``/``accept`` in this package.
+- **Errors are typed envelopes, not pickled exceptions.** A replica
+  failure crosses the wire as ``{"ok": False, "error": {"type": ...,
+  ...}}`` and is re-raised client-side from a fixed vocabulary
+  (``raise_remote_error``), so the router's retry policy can match on
+  exception types exactly as it would in-process.
+
+Payloads are trusted (same user, same host, loopback only) — this is
+an intra-fleet control plane, not a public API surface.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable, Optional, Tuple
+
+from perceiver_tpu.serving.errors import BatchError, Unavailable
+
+_LEN = struct.Struct(">Q")
+_MAX_MSG = 1 << 30  # 1 GiB: corrupt length prefixes fail loudly
+
+
+class RpcError(ConnectionError):
+    """Transport-level RPC failure (connect/send/recv/timeout) — the
+    router treats these as "replica unreachable" and retries the
+    request on a sibling."""
+
+
+def send_msg(sock: socket.socket, obj, timeout: float) -> None:
+    """Pickle ``obj`` and write it length-prefixed within ``timeout``."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.settimeout(timeout)
+    try:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+    except (OSError, ValueError) as e:
+        raise RpcError(f"send failed: {e}") from e
+
+
+def recv_msg(sock: socket.socket, timeout: float):
+    """Read one length-prefixed pickle within ``timeout`` (applied to
+    the socket up front — no blocking read without a deadline)."""
+    sock.settimeout(timeout)
+    try:
+        header = _recv_exact(sock, _LEN.size)
+        if header is None:
+            return None  # clean EOF between messages
+        (length,) = _LEN.unpack(header)
+        if length > _MAX_MSG:
+            raise RpcError(f"message length {length} exceeds cap")
+        body = _recv_exact(sock, length)
+        if body is None:
+            raise RpcError("connection closed mid-message")
+        return pickle.loads(body)
+    except socket.timeout as e:
+        raise RpcError(f"recv timed out after {timeout}s") from e
+    except (OSError, pickle.UnpicklingError, EOFError, ValueError) as e:
+        raise RpcError(f"recv failed: {e}") from e
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes; None on EOF at a message boundary,
+    RpcError on EOF mid-message."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            if got == 0:
+                return None
+            raise RpcError(f"connection closed after {got}/{n} bytes")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+# --- typed error envelopes ---------------------------------------------------
+
+def error_envelope(exc: BaseException) -> dict:
+    """Serialize an exception into the wire vocabulary. Typed serving
+    errors keep their routing-relevant fields; anything else degrades
+    to a generic ``BatchError`` with the message."""
+    if isinstance(exc, Unavailable):
+        return {"type": "Unavailable", "reason": exc.reason,
+                "bucket": exc.bucket,
+                "retry_after_s": exc.retry_after_s}
+    name = type(exc).__name__
+    if name in ("RequestTooLarge", "CheckpointIntegrityError"):
+        return {"type": name, "message": str(exc)}
+    return {"type": "BatchError",
+            "message": f"{name}: {exc}"}
+
+
+def raise_remote_error(err: dict) -> None:
+    """Re-raise a replica's error envelope as the matching local
+    exception type (fixed vocabulary — never unpickles arbitrary
+    exception classes)."""
+    kind = err.get("type")
+    if kind == "Unavailable":
+        raise Unavailable(err.get("reason", "remote"),
+                          bucket=err.get("bucket"),
+                          retry_after_s=err.get("retry_after_s", 0.0))
+    if kind == "RequestTooLarge":
+        from perceiver_tpu.serving.engine import RequestTooLarge
+        raise RequestTooLarge(err.get("message", "request too large"))
+    if kind == "CheckpointIntegrityError":
+        from perceiver_tpu.training.checkpoint import (
+            CheckpointIntegrityError,
+        )
+        raise CheckpointIntegrityError(
+            err.get("message", "integrity check failed"))
+    raise BatchError(err.get("message", "remote failure"))
+
+
+# --- client ------------------------------------------------------------------
+
+class RpcClient:
+    """One persistent connection to a replica, reconnecting on error.
+
+    ``call`` is serialized by a lock (one in-flight request per
+    connection); the router holds one client per replica and relies on
+    per-call timeouts — a replica that stops answering raises
+    :class:`RpcError` here and gets ejected there.
+    """
+
+    def __init__(self, host: str, port: int, *, timeout: float = 10.0,
+                 connect_timeout: float = 5.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self.connect_timeout)
+        except OSError as e:
+            raise RpcError(
+                f"connect to {self.host}:{self.port} failed: {e}") from e
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def call(self, op: str, *, timeout: Optional[float] = None, **kwargs):
+        """Issue one request; return the response payload or re-raise
+        the replica's typed error. Transport failures close the
+        connection (next call reconnects) and raise :class:`RpcError`.
+        """
+        deadline = timeout if timeout is not None else self.timeout
+        with self._lock:
+            if self._sock is None:
+                self._sock = self._connect()
+            try:
+                send_msg(self._sock, {"op": op, **kwargs}, deadline)
+                reply = recv_msg(self._sock, deadline)
+            except RpcError:
+                self._close_locked()
+                raise
+            if reply is None:
+                self._close_locked()
+                raise RpcError("connection closed by replica")
+        if reply.get("ok"):
+            return reply.get("result")
+        raise_remote_error(reply.get("error", {}))
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass  # already dead — close is best-effort
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+
+# --- server ------------------------------------------------------------------
+
+class RpcServer:
+    """Threaded request/response server for a replica process.
+
+    ``handler(request dict) -> result`` runs on a per-connection
+    thread; its return value is wrapped in an ``ok`` envelope, its
+    exceptions in a typed error envelope. The listener itself polls
+    with a timeout so ``close()`` is prompt.
+    """
+
+    def __init__(self, handler: Callable[[dict], object], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 io_timeout: float = 60.0):
+        self._handler = handler
+        self._io_timeout = io_timeout
+        self._closed = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.settimeout(0.2)  # poll so close() is prompt
+        self._listener.bind((host, port))
+        self._listener.listen(32)
+        self.address: Tuple[str, int] = self._listener.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-rpc-accept", daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.address[1]
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            conn.settimeout(self._io_timeout)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="fleet-rpc-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                try:
+                    request = recv_msg(conn, self._io_timeout)
+                except RpcError:
+                    return  # peer vanished / stalled out: drop the conn
+                if request is None:
+                    return  # clean disconnect
+                try:
+                    result = self._handler(request)
+                    reply = {"ok": True, "result": result}
+                except Exception as e:  # noqa: BLE001 — typed envelope
+                    reply = {"ok": False, "error": error_envelope(e)}
+                try:
+                    send_msg(conn, reply, self._io_timeout)
+                except RpcError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass  # peer already gone
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass  # double close is fine
+        self._accept_thread.join(2.0)

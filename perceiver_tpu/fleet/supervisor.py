@@ -1,0 +1,353 @@
+"""Replica process lifecycle: spawn, monitor, restart with backoff.
+
+The supervisor owns the fleet's OS processes the way
+``data/prefetch.py`` owns its producer thread: a crashed replica is
+*routine input* — the monitor notices the dead process, removes it
+from the router (its in-flight requests already failed over via the
+router's retry path), and respawns it with exponential backoff under a
+``max_restarts`` poison-pill budget. A replica that keeps dying stays
+dead and the fleet runs smaller; the budget is per-slot and resets on
+a healthy restart.
+
+``Fleet`` at the bottom is the user-facing facade wiring router +
+supervisor + autoscaler + rolling updates into one object
+(docs/SERVING.md "Fleet").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from perceiver_tpu.fleet.router import Router
+from perceiver_tpu.fleet.rpc import RpcClient, RpcError
+
+_REPLICA_MODULE = "perceiver_tpu.fleet.replica"
+
+
+class ReplicaSpawnError(RuntimeError):
+    """A replica process died (or stalled) before printing READY."""
+
+
+class RpcReplicaHandle:
+    """The router-facing view of one replica process: thin RPC calls
+    with per-op timeouts (``dispatch`` gets the long one, control ops
+    a short one so probing a dead replica is cheap)."""
+
+    def __init__(self, host: str, port: int, *,
+                 dispatch_timeout_s: float = 15.0,
+                 control_timeout_s: float = 5.0):
+        self._client = RpcClient(host, port, timeout=dispatch_timeout_s,
+                                 connect_timeout=control_timeout_s)
+        self._control_timeout = control_timeout_s
+
+    def dispatch(self, arrays: dict) -> dict:
+        return self._client.call("dispatch", arrays=arrays)
+
+    def status(self) -> dict:
+        return self._client.call("status", timeout=self._control_timeout)
+
+    def update_version(self, version: str) -> dict:
+        # a cutover waits for in-flight work to quiesce; give it the
+        # dispatch budget, not the control budget
+        return self._client.call("update_version", version=version)
+
+    def metrics_text(self) -> str:
+        return self._client.call("metrics", timeout=self._control_timeout)
+
+    def shutdown(self) -> None:
+        self._client.call("shutdown", timeout=self._control_timeout)
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class ReplicaProcess:
+    """One spawned replica: subprocess + spec file + RPC handle."""
+
+    def __init__(self, rid: str, spec: dict, workdir: str, *,
+                 ready_timeout_s: float = 120.0,
+                 env: Optional[dict] = None,
+                 dispatch_timeout_s: float = 15.0):
+        self.rid = rid
+        self.spec = dict(spec)
+        os.makedirs(workdir, exist_ok=True)
+        self.spec_path = os.path.join(workdir, f"{rid}.spec.json")
+        with open(self.spec_path, "w") as f:
+            json.dump(self.spec, f, indent=1)
+        self.log_path = os.path.join(workdir, f"{rid}.log")
+        self._log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", _REPLICA_MODULE,
+             "--spec", self.spec_path],
+            stdout=subprocess.PIPE, stderr=self._log,
+            env=env if env is not None else dict(os.environ), text=True)
+        self.port = self._await_ready(ready_timeout_s)
+        self.handle = RpcReplicaHandle(
+            "127.0.0.1", self.port,
+            dispatch_timeout_s=dispatch_timeout_s)
+
+    def _await_ready(self, timeout: float) -> int:
+        """Block until the replica prints ``READY <port>`` (its engine
+        is warmed) or dies."""
+        deadline = time.monotonic() + timeout
+        line_box: List[str] = []
+
+        def read_line():
+            line_box.append(self.proc.stdout.readline())
+
+        # readline on a pipe has no timeout parameter; a watchdog
+        # thread keeps a wedged replica from wedging the supervisor
+        reader = threading.Thread(target=read_line, daemon=True)
+        reader.start()
+        reader.join(max(0.0, deadline - time.monotonic()))
+        line = line_box[0] if line_box else ""
+        if not line.startswith("READY "):
+            self.kill()
+            raise ReplicaSpawnError(
+                f"replica {self.rid} did not come up "
+                f"(got {line!r}; log: {self.log_path})")
+        return int(line.split()[1])
+
+    def poll(self) -> Optional[int]:
+        """The process's exit code, or None while alive."""
+        return self.proc.poll()
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except OSError:
+            pass  # already gone
+        self.proc.wait(timeout=10)
+        self._log.close()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Graceful: shutdown RPC, then wait; escalate to kill."""
+        try:
+            self.handle.shutdown()
+        except (RpcError, OSError):
+            pass  # dead already — fall through to kill
+        try:
+            self.proc.wait(timeout=timeout)
+            self._log.close()
+        except subprocess.TimeoutExpired:
+            self.kill()
+        self.handle.close()
+
+
+class Supervisor:
+    """Monitor replica processes; restart crashes with backoff.
+
+    ``on_change(rid, handle_or_None)`` tells the router about
+    membership: a live handle on (re)spawn, ``None`` on death/retire.
+    """
+
+    def __init__(self, spec: dict, workdir: str, *,
+                 max_restarts: int = 3, backoff_s: float = 0.2,
+                 poll_interval_s: float = 0.2,
+                 ready_timeout_s: float = 120.0,
+                 dispatch_timeout_s: float = 15.0,
+                 on_change: Optional[Callable] = None,
+                 env: Optional[dict] = None,
+                 per_replica_env: Optional[Dict[str, dict]] = None):
+        self.spec = dict(spec)
+        self.workdir = workdir
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.ready_timeout_s = ready_timeout_s
+        self.dispatch_timeout_s = dispatch_timeout_s
+        self._on_change = on_change or (lambda rid, handle: None)
+        self._env = env
+        self._per_replica_env = per_replica_env or {}
+        self._lock = threading.Lock()
+        self._procs: Dict[str, ReplicaProcess] = {}
+        self._restarts: Dict[str, int] = {}
+        self._poisoned: set = set()
+        self._next_id = 0
+        self._closed = threading.Event()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, args=(poll_interval_s,),
+            name="fleet-supervisor", daemon=True)
+        self._monitor.start()
+
+    # -- membership -------------------------------------------------------
+
+    def spawn(self, rid: Optional[str] = None) -> str:
+        """Start one replica (blocking until READY) and announce it."""
+        with self._lock:
+            if rid is None:
+                rid = f"r{self._next_id}"
+                self._next_id += 1
+        proc = self._spawn_proc(rid)
+        with self._lock:
+            self._procs[rid] = proc
+            self._restarts.setdefault(rid, 0)
+        self._on_change(rid, proc.handle)
+        return rid
+
+    def _spawn_proc(self, rid: str) -> ReplicaProcess:
+        env = dict(self._env if self._env is not None else os.environ)
+        env.update(self._per_replica_env.get(rid, {}))
+        return ReplicaProcess(
+            rid, self.spec, self.workdir,
+            ready_timeout_s=self.ready_timeout_s,
+            dispatch_timeout_s=self.dispatch_timeout_s, env=env)
+
+    def retire(self, rid: str) -> None:
+        """Graceful scale-down: announce removal first (router drains),
+        then stop the process."""
+        with self._lock:
+            proc = self._procs.pop(rid, None)
+        self._on_change(rid, None)
+        if proc is not None:
+            proc.stop()
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return sorted(self._procs)
+
+    def pid_of(self, rid: str) -> Optional[int]:
+        with self._lock:
+            proc = self._procs.get(rid)
+            return proc.pid if proc is not None else None
+
+    def handle_of(self, rid: str):
+        with self._lock:
+            proc = self._procs.get(rid)
+            return proc.handle if proc is not None else None
+
+    def restarts_of(self, rid: str) -> int:
+        with self._lock:
+            return self._restarts.get(rid, 0)
+
+    # -- monitoring -------------------------------------------------------
+
+    def _monitor_loop(self, interval: float) -> None:
+        while not self._closed.wait(interval):
+            with self._lock:
+                dead = [(rid, proc) for rid, proc in self._procs.items()
+                        if proc.poll() is not None]
+            for rid, proc in dead:
+                self._handle_death(rid, proc)
+
+    def _handle_death(self, rid: str, proc: ReplicaProcess) -> None:
+        """A replica crashed: pull it from routing, then restart it
+        with exponential backoff under the poison-pill budget (the
+        ``data/prefetch.py`` supervisor contract, at process scope)."""
+        self._on_change(rid, None)
+        with self._lock:
+            self._procs.pop(rid, None)
+            restarts = self._restarts.get(rid, 0)
+            if restarts >= self.max_restarts:
+                self._poisoned.add(rid)
+                return
+            self._restarts[rid] = restarts + 1
+        if self._closed.wait(self.backoff_s * (2 ** restarts)):
+            return
+        try:
+            replacement = self._spawn_proc(rid)
+        except ReplicaSpawnError:
+            self._poisoned.add(rid)
+            return
+        with self._lock:
+            self._procs[rid] = replacement
+        self._on_change(rid, replacement.handle)
+
+    @property
+    def poisoned(self) -> List[str]:
+        """Replica slots whose restart budget is spent."""
+        with self._lock:
+            return sorted(self._poisoned)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._monitor.join(5.0)
+        with self._lock:
+            procs = list(self._procs.items())
+            self._procs.clear()
+        for rid, proc in procs:
+            self._on_change(rid, None)
+            proc.stop()
+
+
+class Fleet:
+    """Router + supervisor (+ optional autoscaler) behind one object.
+
+    >>> fleet = Fleet(spec, workdir, replicas=3)
+    >>> out = fleet.submit(arrays)           # typed-error contract
+    >>> fleet.rolling_update("v2")           # zero-downtime cutover
+    >>> fleet.close()
+    """
+
+    def __init__(self, spec: dict, workdir: str, *, replicas: int = 2,
+                 router: Optional[Router] = None,
+                 max_restarts: int = 3,
+                 dispatch_timeout_s: float = 15.0,
+                 ready_timeout_s: float = 120.0,
+                 autoscaler=None,
+                 per_replica_env: Optional[Dict[str, dict]] = None):
+        self.spec = dict(spec)
+        self.router = router if router is not None else Router()
+        self.supervisor = Supervisor(
+            self.spec, workdir, max_restarts=max_restarts,
+            dispatch_timeout_s=dispatch_timeout_s,
+            ready_timeout_s=ready_timeout_s,
+            on_change=self._membership_change,
+            per_replica_env=per_replica_env)
+        self.autoscaler = autoscaler
+        if self.autoscaler is not None:
+            self.autoscaler.bind(self)
+        for _ in range(replicas):
+            self.supervisor.spawn()
+
+    def _membership_change(self, rid: str, handle) -> None:
+        if handle is None:
+            self.router.remove(rid)
+        else:
+            self.router.add(rid, handle)
+
+    def submit(self, arrays: dict) -> dict:
+        return self.router.submit(arrays)
+
+    def size(self) -> int:
+        return len(self.router.replicas())
+
+    def scale_to(self, n: int) -> None:
+        """Spawn or retire replicas to reach ``n`` (autoscaler hook)."""
+        current = self.supervisor.replicas()
+        for _ in range(n - len(current)):
+            self.supervisor.spawn()
+        for rid in current[n:]:
+            self.router.drain(rid)
+            self.router.wait_idle(rid)
+            self.supervisor.retire(rid)
+
+    def rolling_update(self, version: str, **kwargs) -> dict:
+        from perceiver_tpu.fleet.rollout import rolling_update
+
+        return rolling_update(self, version, **kwargs)
+
+    def statuses(self) -> Dict[str, dict]:
+        out = {}
+        for rid in self.supervisor.replicas():
+            handle = self.supervisor.handle_of(rid)
+            if handle is None:
+                continue
+            try:
+                out[rid] = handle.status()
+            except (RpcError, OSError):
+                out[rid] = {"health": "UNAVAILABLE"}
+        return out
+
+    def close(self) -> None:
+        self.supervisor.close()
+        self.router.close()

@@ -38,6 +38,9 @@ Known points (arming an unknown name is a loud ``ValueError``):
 ``ckpt.truncate``        truncate a checkpoint blob after its manifest
 ``ckpt.kill_during_save``  SIGKILL this process mid-checkpoint-save
 ``serve.dispatch``       raise inside the serving engine's dispatch
+``replica.stall``        sleep ``value`` seconds in a fleet replica's
+                         dispatch handler (default 30)
+``replica.crash``        SIGKILL a fleet replica mid-dispatch
 =======================  ====================================================
 """
 
@@ -58,6 +61,8 @@ POINTS = frozenset({
     "ckpt.truncate",
     "ckpt.kill_during_save",
     "serve.dispatch",
+    "replica.stall",
+    "replica.crash",
 })
 
 ENV_VAR = "PERCEIVER_FAULTS"

@@ -60,6 +60,8 @@ class _Server:
             self._run_batch, max_batch=max_batch,
             max_delay_ms=max_delay_ms, max_depth=max_depth,
             metrics=self.metrics)
+        self._close_lock = threading.Lock()
+        self._closed = False
 
     def _run_batch(self, payloads: List[object]) -> Sequence[object]:
         raise NotImplementedError
@@ -80,8 +82,23 @@ class _Server:
         """Prometheus text exposition of every serving metric."""
         return self.metrics.render()
 
-    def close(self):
-        self.batcher.close()
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted request has resolved (queue empty
+        and nothing inside the runner). The rolling-update cutover
+        calls this before ``engine.update_params``."""
+        return self.batcher.drain(timeout)
+
+    def close(self, timeout: float = 5.0):
+        """Drain in-flight work, then stop the batcher. Idempotent:
+        concurrent/repeated closes are no-ops. Requests still queued
+        past ``timeout`` resolve with a typed
+        ``Unavailable("shutting_down")``, never a silent dead future."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.batcher.drain(timeout)
+        self.batcher.close(timeout)
 
 
 @dataclasses.dataclass(frozen=True)

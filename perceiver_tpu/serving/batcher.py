@@ -87,6 +87,7 @@ class MicroBatcher:
         self._not_empty = threading.Condition(self._lock)
         self._queue: collections.deque = collections.deque()
         self._closed = False
+        self._inflight = 0  # requests handed to the runner, unresolved
 
         m = metrics if metrics is not None else MetricsRegistry()
         self.metrics = m
@@ -142,12 +143,52 @@ class MicroBatcher:
         with self._lock:
             return len(self._queue)
 
+    @property
+    def inflight(self) -> int:
+        """Requests currently inside the runner (unresolved)."""
+        with self._lock:
+            return self._inflight
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted request has resolved — queue
+        empty AND no batch inside the runner. This is the rolling
+        update's cutover precondition (docs/SERVING.md "Fleet"): after
+        a successful drain, no request can be served mid-param-swap.
+        Returns False if ``timeout`` expires first."""
+        deadline = (None if timeout is None
+                    else self._clock() + timeout)
+        with self._not_empty:
+            while self._queue or self._inflight:
+                if deadline is not None \
+                        and self._clock() >= deadline:
+                    return False
+                self._not_empty.wait(0.05)
+        return True
+
     def close(self, timeout: float = 5.0) -> None:
-        """Stop the worker; queued requests still drain first."""
+        """Stop the worker; queued requests drain first. Idempotent —
+        a second close returns immediately. If the worker cannot drain
+        within ``timeout`` (a wedged runner), every request still
+        queued is failed with a typed ``Unavailable("shutting_down")``
+        instead of leaving its caller blocked on a future that will
+        never resolve."""
         with self._lock:
             self._closed = True
             self._not_empty.notify_all()
         self._worker.join(timeout)
+        if not self._worker.is_alive():
+            return
+        # the worker missed its deadline: take the queue over (same
+        # lock the worker pops under — no double delivery) and resolve
+        # every stranded future with the typed shutdown error
+        with self._lock:
+            leftover = list(self._queue)
+            self._queue.clear()
+            self._m_depth.set(0)
+        err = Unavailable("shutting_down")
+        for p in leftover:
+            self._m_served.labels(outcome="unavailable").inc()
+            p.future.set_exception(err)
 
     # -- worker side ------------------------------------------------------
 
@@ -170,6 +211,7 @@ class MicroBatcher:
                     break
                 self._not_empty.wait(remaining)
             self._m_depth.set(len(self._queue))
+            self._inflight = len(batch)
             return batch
 
     def _loop(self) -> None:
@@ -177,40 +219,48 @@ class MicroBatcher:
             batch = self._take_batch()
             if batch is None:
                 return
-            now = self._clock()
-            live: List[_Pending] = []
-            for p in batch:
-                if p.deadline is not None and now > p.deadline:
-                    self._m_shed.labels(reason="deadline").inc()
-                    self._m_served.labels(outcome="shed").inc()
-                    p.future.set_result(
-                        Overloaded("deadline", len(batch)))
-                else:
-                    live.append(p)
-            if not live:
-                continue
             try:
-                results = self._runner([p.payload for p in live])
-                if len(results) != len(live):
-                    raise RuntimeError(
-                        f"runner returned {len(results)} results for "
-                        f"{len(live)} requests")
-            except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
-                self._m_failed_batches.inc()
-                # batch-failure isolation: one typed error per request,
-                # never a raw internal traceback or a dead worker
-                err = e if isinstance(e, ServingError) else BatchError(
-                    f"batch of {len(live)} failed: {type(e).__name__}: "
-                    f"{e}", cause=e)
-                outcome = ("unavailable" if isinstance(e, Unavailable)
-                           else "error")
-                for p in live:
-                    self._m_served.labels(outcome=outcome).inc()
-                    p.future.set_exception(err)
-                continue
-            done = self._clock()
-            self._m_batch.observe(float(len(live)))
-            for p, r in zip(live, results):
-                self._m_latency.observe(done - p.enqueued_at)
-                self._m_served.labels(outcome="ok").inc()
-                p.future.set_result(r)
+                self._run_one(batch)
+            finally:
+                with self._lock:
+                    self._inflight = 0
+                    self._not_empty.notify_all()  # wake drain()ers
+
+    def _run_one(self, batch: List[_Pending]) -> None:
+        now = self._clock()
+        live: List[_Pending] = []
+        for p in batch:
+            if p.deadline is not None and now > p.deadline:
+                self._m_shed.labels(reason="deadline").inc()
+                self._m_served.labels(outcome="shed").inc()
+                p.future.set_result(
+                    Overloaded("deadline", len(batch)))
+            else:
+                live.append(p)
+        if not live:
+            return
+        try:
+            results = self._runner([p.payload for p in live])
+            if len(results) != len(live):
+                raise RuntimeError(
+                    f"runner returned {len(results)} results for "
+                    f"{len(live)} requests")
+        except Exception as e:  # noqa: BLE001 — fail the batch, not the loop
+            self._m_failed_batches.inc()
+            # batch-failure isolation: one typed error per request,
+            # never a raw internal traceback or a dead worker
+            err = e if isinstance(e, ServingError) else BatchError(
+                f"batch of {len(live)} failed: {type(e).__name__}: "
+                f"{e}", cause=e)
+            outcome = ("unavailable" if isinstance(e, Unavailable)
+                       else "error")
+            for p in live:
+                self._m_served.labels(outcome=outcome).inc()
+                p.future.set_exception(err)
+            return
+        done = self._clock()
+        self._m_batch.observe(float(len(live)))
+        for p, r in zip(live, results):
+            self._m_latency.observe(done - p.enqueued_at)
+            self._m_served.labels(outcome="ok").inc()
+            p.future.set_result(r)

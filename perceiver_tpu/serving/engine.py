@@ -48,7 +48,12 @@ import numpy as np
 from perceiver_tpu.cache import ExecutableCache, aot_compile, default_cache
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
 from perceiver_tpu.resilience import faults
-from perceiver_tpu.resilience.breaker import OPEN, CircuitBreaker
+from perceiver_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
 from perceiver_tpu.serving.errors import Unavailable
 from perceiver_tpu.serving.graphs import ServeGraph, build_serve_graph
 from perceiver_tpu.serving.health import HealthMonitor, HealthState
@@ -57,6 +62,9 @@ from perceiver_tpu.serving.metrics import MetricsRegistry
 # occupancy/waste are fractions in [0, 1] — linear buckets, not the
 # latency defaults
 _RATIO_BUCKETS = tuple(i / 10 for i in range(1, 11))
+
+# serving_breaker_state gauge encoding (docs/SERVING.md "Fleet")
+_BREAKER_STATE_VALUES = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
 
 
 class RequestTooLarge(ValueError):
@@ -240,6 +248,16 @@ class ServingEngine:
         self._m_unavailable = m.counter(
             "serving_unavailable_total",
             "requests rejected with typed Unavailable, by reason")
+        # router/operator signals (docs/SERVING.md "Fleet"): the full
+        # per-bucket breaker state (not just the open count) and the
+        # retry-after hint the engine last attached to an Unavailable
+        self._m_breaker_state = m.gauge(
+            "serving_breaker_state",
+            "per-bucket circuit state: 0=closed 1=half_open 2=open")
+        self._m_retry_after = m.gauge(
+            "serving_retry_after_seconds",
+            "retry-after hint carried by the most recent typed "
+            "Unavailable (0 when nothing is failing fast)")
 
     # -- compilation ------------------------------------------------------
 
@@ -328,7 +346,13 @@ class ServingEngine:
                 "update_params requires the same pytree structure, "
                 "shapes, and dtypes as the params the engine compiled "
                 "against — rebuild the engine for a new architecture")
+        # the whole tree swaps in one attribute assignment, so a
+        # concurrent dispatch reads entirely-old or entirely-new params
+        # (never a torn pytree — pinned by tests/test_serving.py);
+        # _params_src must track the swap or a later update back to a
+        # previously-seen host object would silently no-op
         self._params = jax.device_put(params)
+        self._params_src = params
 
     # -- failure handling -------------------------------------------------
 
@@ -347,12 +371,18 @@ class ServingEngine:
                     on_transition=lambda old, new, _n=name:
                         self._on_breaker_transition(_n, old, new))
                 self._breakers[bucket] = breaker
+                self._m_breaker_state.labels(bucket=name).set(
+                    _BREAKER_STATE_VALUES[breaker.state])
             return breaker
 
     def _on_breaker_transition(self, bucket_name: str, old: str,
                                new: str) -> None:
         self._m_breaker_transitions.labels(bucket=bucket_name,
                                            to=new).inc()
+        self._m_breaker_state.labels(bucket=bucket_name).set(
+            _BREAKER_STATE_VALUES[new])
+        if new != OPEN:
+            self._m_retry_after.set(0.0)
         self._update_health()
 
     def _update_health(self) -> None:
@@ -444,9 +474,11 @@ class ServingEngine:
         if not breaker.allow():
             # fail fast with backpressure instead of queueing work
             # behind a bucket that keeps failing (docs/RESILIENCE.md)
+            retry_after = breaker.retry_after()
             self._m_unavailable.labels(reason="circuit_open").inc()
+            self._m_retry_after.set(retry_after)
             raise Unavailable("circuit_open", bucket=bucket,
-                              retry_after_s=breaker.retry_after())
+                              retry_after_s=retry_after)
         with self._exe_lock:
             known = bucket in self._exe
         if known:

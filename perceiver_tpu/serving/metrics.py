@@ -103,7 +103,11 @@ class _CounterChild:
 
 
 class Gauge:
-    """Set-to-current-value metric (queue depth, bucket count)."""
+    """Set-to-current-value metric (queue depth, bucket count).
+
+    Like :class:`Counter`, ``labels(...)`` returns a per-label-set
+    child — how the engine exposes per-bucket breaker state and the
+    fleet router per-replica occupancy on one metric name."""
 
     kind = "gauge"
 
@@ -112,6 +116,13 @@ class Gauge:
         self.help = help
         self._lock = threading.Lock()
         self._value = 0.0
+        self._children: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def labels(self, **labels) -> "_GaugeChild":
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._children.setdefault(key, 0.0)
+        return _GaugeChild(self, key)
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -124,13 +135,54 @@ class Gauge:
     def dec(self, amount: float = 1.0) -> None:
         self.inc(-amount)
 
+    def _set_child(self, key, value: float) -> None:
+        with self._lock:
+            self._children[key] = float(value)
+
+    def _remove_child(self, key) -> None:
+        with self._lock:
+            self._children.pop(key, None)
+
     @property
     def value(self) -> float:
         with self._lock:
             return self._value
 
+    def value_of(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._children.get(key, 0.0)
+
+    def items(self):
+        """Snapshot of (labels dict, value) per label set."""
+        with self._lock:
+            return [(dict(k), v)
+                    for k, v in sorted(self._children.items())]
+
     def collect(self) -> Iterable[str]:
-        yield f"{self.name} {_fmt_value(self.value)}"
+        with self._lock:
+            value = self._value
+            children = sorted(self._children.items())
+        if not children or value != 0.0:
+            yield f"{self.name} {_fmt_value(value)}"
+        for key, v in children:
+            yield f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}"
+
+
+class _GaugeChild:
+    __slots__ = ("_parent", "_key")
+
+    def __init__(self, parent: Gauge, key):
+        self._parent = parent
+        self._key = key
+
+    def set(self, value: float) -> None:
+        self._parent._set_child(self._key, value)
+
+    def remove(self) -> None:
+        """Drop this label set from the exposition (a retired
+        replica's gauges must not linger as stale zeros)."""
+        self._parent._remove_child(self._key)
 
 
 class Histogram:

@@ -303,6 +303,90 @@ def _partial_restore(path: str, item: dict) -> dict:
         return ckptr.restore(path, args=args)
 
 
+class ParamsVersionStore:
+    """Versioned, sha256-sealed params directory for fleet rollouts.
+
+    Layout: ``<dir>/<version>/params/...`` (a ``save_params`` tree)
+    sealed by the same ``manifest.sha256.json`` as training
+    checkpoints, plus an atomically-replaced ``CURRENT`` pointer file.
+    The rolling-update protocol (docs/SERVING.md "Fleet") loads a
+    version only after :meth:`verify` returns ``VERIFIED`` — a blob
+    that rotted (or was corrupted mid-publish) raises the same typed
+    :class:`CheckpointIntegrityError` the trainer uses, which the
+    rollout turns into an auto-rollback.
+    """
+
+    CURRENT_NAME = "CURRENT"
+
+    def __init__(self, directory: str):
+        self.directory = _abs(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- publishing -------------------------------------------------------
+
+    def publish(self, version: str, params: Any,
+                *, set_current: bool = True) -> str:
+        """Write ``params`` as ``version``, seal it with a manifest,
+        and (by default) flip the CURRENT pointer. Returns the version
+        directory. Re-publishing an existing version is an error —
+        versions are immutable once sealed."""
+        if not version or os.sep in version or version == self.CURRENT_NAME:
+            raise ValueError(f"bad version name {version!r}")
+        vdir = self.path(version)
+        if os.path.exists(vdir):
+            raise FileExistsError(f"version {version!r} already published")
+        save_params(vdir, params)
+        write_manifest(vdir)
+        if set_current:
+            self.set_current(version)
+        return vdir
+
+    def set_current(self, version: str) -> None:
+        """Atomically repoint CURRENT (tempfile + ``os.replace`` — a
+        crash leaves the old pointer, never a torn one)."""
+        if version not in self.versions():
+            raise FileNotFoundError(f"unknown version {version!r}")
+        tmp = os.path.join(self.directory,
+                           f".{self.CURRENT_NAME}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(version + "\n")
+        os.replace(tmp, os.path.join(self.directory, self.CURRENT_NAME))
+
+    # -- reading ----------------------------------------------------------
+
+    def path(self, version: str) -> str:
+        return os.path.join(self.directory, version)
+
+    def versions(self):
+        """Published version names, sorted."""
+        return sorted(
+            d for d in os.listdir(self.directory)
+            if os.path.isdir(self.path(d)) and not d.startswith("."))
+
+    def current(self) -> Optional[str]:
+        try:
+            with open(os.path.join(self.directory, self.CURRENT_NAME)) as f:
+                version = f.read().strip()
+        except OSError:
+            return None
+        return version or None
+
+    def verify(self, version: str) -> str:
+        """``VERIFIED`` | ``CORRUPT`` | ``UNVERIFIED`` for one version."""
+        return verify_step(self.path(version))
+
+    def load(self, version: str, template: Any = None) -> Any:
+        """Verified load: raises :class:`CheckpointIntegrityError` if
+        the version's manifest check fails, so a replica can never
+        swap in rotted params mid-rollout."""
+        status = self.verify(version)
+        if status == CORRUPT:
+            raise CheckpointIntegrityError(
+                f"params version {version!r} in {self.directory} fails "
+                f"sha256 manifest verification")
+        return restore_params(self.path(version), template)
+
+
 def restore_params(path: str, template: Any = None) -> Any:
     """Load a params pytree from either a ``save_params`` directory or a
     ``CheckpointHook`` step directory (transfer-learning source,

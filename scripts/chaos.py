@@ -304,6 +304,310 @@ def scenario_serve_dispatch(tmp: str) -> dict:
                 m.get("serving_failed_batches_total").value}
 
 
+# --- fleet scenarios (docs/SERVING.md "Fleet") -------------------------------
+#
+# Each builds a real multi-process fleet (router + supervisor +
+# replica subprocesses) inside the scenario child, runs concurrent
+# traffic through it while one fault lands, and asserts ZERO dropped
+# requests: every submitted request resolves with a result or a typed
+# ServingError — never a hang, never a raw traceback.
+
+_FLEET_TASK_KWARGS = dict(
+    vocab_size=110, max_seq_len=32, num_latents=4,
+    num_latent_channels=8, num_encoder_layers=1,
+    num_encoder_self_attention_layers_per_block=1,
+    num_encoder_cross_attention_heads=1,
+    num_encoder_self_attention_heads=1,
+    num_decoder_cross_attention_heads=1, loss_impl="dense")
+
+
+def _fleet_store(tmp: str, versions=("v1", "v2")):
+    """Publish fresh-init params versions into a sealed store."""
+    from perceiver_tpu.serving.graphs import build_serve_graph
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+    from perceiver_tpu.training.checkpoint import ParamsVersionStore
+
+    graph = build_serve_graph(
+        MaskedLanguageModelTask(**_FLEET_TASK_KWARGS))
+    store = ParamsVersionStore(os.path.join(tmp, "store"))
+    for seed, version in enumerate(versions):
+        store.publish(version, graph.init_params(seed),
+                      set_current=(seed == 0))
+    return store
+
+
+def _fleet_spec(store) -> dict:
+    return {"task_class": "MaskedLanguageModelTask",
+            "task_kwargs": _FLEET_TASK_KWARGS,
+            "batch_buckets": [4], "seq_buckets": [16],
+            "store_dir": store.directory, "version": "v1", "seed": 0}
+
+
+def _start_fleet(tmp: str, store, *, replicas: int,
+                 per_replica_env=None, dispatch_timeout_s: float = 15.0,
+                 max_restarts: int = 3):
+    from perceiver_tpu.fleet import Fleet
+
+    # replicas share one persistent exec cache: the first spin-up
+    # compiles and stores, the rest deserialize (zero-compile)
+    os.environ.setdefault("PERCEIVER_EXEC_CACHE",
+                          os.path.join(tmp, "exec_cache"))
+    return Fleet(_fleet_spec(store), os.path.join(tmp, "fleet"),
+                 replicas=replicas, max_restarts=max_restarts,
+                 dispatch_timeout_s=dispatch_timeout_s,
+                 per_replica_env=per_replica_env)
+
+
+def _fleet_traffic(fleet, *, threads: int, requests: int,
+                   interval_s: float = 0.01):
+    """Drive concurrent traffic; account for every single request.
+
+    Returns (counts, dropped): ``dropped`` collects anything outside
+    the typed contract — a non-ServingError exception, or a typed
+    Unavailable carrying no retry_after hint when the fleet claims
+    saturation. Zero dropped is every fleet scenario's core assertion.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    from perceiver_tpu.serving.errors import ServingError, Unavailable
+
+    counts = {"ok": 0, "unavailable": 0}
+    dropped = []
+    lock = _threading.Lock()
+
+    def worker(seed: int):
+        rng = np.random.default_rng(seed)
+        for i in range(requests):
+            arrays = {
+                "input_ids": rng.integers(
+                    3, 110, (2, 16)).astype(np.int32),
+                "pad_mask": np.zeros((2, 16), bool)}
+            try:
+                out = fleet.submit(arrays)
+                assert "outputs" in out and "topk_ids" in out["outputs"]
+                with lock:
+                    counts["ok"] += 1
+            except Unavailable as e:
+                with lock:
+                    if e.retry_after_s > 0:
+                        counts["unavailable"] += 1
+                    else:
+                        dropped.append(f"no retry_after: {e}")
+            except ServingError:
+                with lock:
+                    counts["unavailable"] += 1
+            except Exception as e:  # noqa: BLE001 — the dropped bucket
+                with lock:
+                    dropped.append(f"{type(e).__name__}: {e}")
+            time.sleep(interval_s)
+
+    pool = [_threading.Thread(target=worker, args=(s,), daemon=True)
+            for s in range(threads)]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join(300)
+    total = counts["ok"] + counts["unavailable"] + len(dropped)
+    assert total == threads * requests, (total, threads * requests)
+    return counts, dropped
+
+
+def scenario_fleet_kill_replica(tmp: str) -> dict:
+    """kill -9 a replica mid-traffic (the ``replica.crash`` fault
+    SIGKILLs it mid-dispatch): the in-flight request transparently
+    fails over to a sibling, the supervisor restarts the dead replica
+    with backoff, and every request resolves — zero dropped."""
+    store = _fleet_store(tmp, versions=("v1",))
+    crash_env = {"PERCEIVER_FAULTS": "replica.crash@at=5"}
+    fleet = _start_fleet(tmp, store, replicas=3,
+                         per_replica_env={"r0": crash_env},
+                         dispatch_timeout_s=8.0)
+    try:
+        counts, dropped = _fleet_traffic(fleet, threads=4, requests=25)
+        # the crash counter ticks before the respawn finishes; wait
+        # for the replacement to actually rejoin the router
+        deadline = time.monotonic() + 60
+        while (fleet.supervisor.restarts_of("r0") < 1
+               or fleet.size() < 3) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        crashes = fleet.supervisor.restarts_of("r0")
+        retries = fleet.router.metrics.get("fleet_retries_total").value
+        size = fleet.size()
+    finally:
+        fleet.close()
+    assert not dropped, dropped
+    assert counts["ok"] >= 90, counts     # the fleet kept serving
+    assert crashes >= 1, "victim never crashed"
+    assert retries >= 1, "no request failed over"
+    assert size == 3, size                # supervisor restarted the slot
+    return {"requests": counts, "dropped": len(dropped),
+            "replica_crashes": crashes, "router_retries": retries,
+            "fleet_size_after": size,
+            "faults_fired": {"replica.crash": crashes}}
+
+
+def scenario_fleet_stall(tmp: str) -> dict:
+    """A replica's dispatch path stalls (``replica.stall``): the
+    router's recv deadline converts the hang into retry-on-sibling,
+    repeated deadline hits eject the replica (breaker opens), and a
+    half-open traffic probe readmits it once the stall clears. Zero
+    dropped, zero hung requests."""
+    store = _fleet_store(tmp, versions=("v1",))
+    stall_env = {"PERCEIVER_FAULTS": "replica.stall@at=3,count=3,value=4"}
+    fleet = _start_fleet(tmp, store, replicas=3,
+                         per_replica_env={"r0": stall_env},
+                         dispatch_timeout_s=1.5)
+    try:
+        counts, dropped = _fleet_traffic(fleet, threads=4, requests=25)
+        m = fleet.router.metrics
+        ejections = m.get("fleet_ejections_total").value
+        retries = m.get("fleet_retries_total").value
+        status = fleet.statuses().get("r0", {})
+    finally:
+        fleet.close()
+    assert not dropped, dropped
+    assert counts["ok"] >= 90, counts
+    assert ejections >= 1, "stalled replica was never ejected"
+    assert retries >= 3, retries
+    fired = status.get("faults_fired", {})
+    assert fired.get("replica.stall") == 3, fired
+    return {"requests": counts, "dropped": len(dropped),
+            "ejections": ejections, "router_retries": retries,
+            "faults_fired": fired}
+
+
+def scenario_fleet_rollout_corrupt(tmp: str) -> dict:
+    """Mid-rollout checkpoint corruption: after the first replica cut
+    over to v2, the v2 blobs rot (truncated post-seal). The next
+    replica's verified load fails typed, the rollout auto-rolls the
+    updated replica back to v1, CURRENT never moves, and traffic never
+    drops a request."""
+    from perceiver_tpu.fleet import RolloutAborted
+    from perceiver_tpu.training.checkpoint import (
+        CheckpointIntegrityError,
+        verify_step,
+    )
+
+    store = _fleet_store(tmp, versions=("v1", "v2"))
+    fleet = _start_fleet(tmp, store, replicas=3)
+    corrupted = []
+
+    def corrupt_v2_once(rid):
+        if corrupted:
+            return
+        vdir = store.path("v2")
+        blobs = [(os.path.getsize(os.path.join(r, f)),
+                  os.path.join(r, f))
+                 for r, _, fs in os.walk(vdir) for f in fs
+                 if "manifest" not in f]
+        _, victim = max(blobs)
+        with open(victim, "r+b") as f:
+            f.truncate(max(os.path.getsize(victim) // 2, 1))
+        corrupted.append(rid)
+
+    try:
+        import threading as _threading
+
+        background = {"counts": None, "dropped": None}
+
+        def traffic():
+            background["counts"], background["dropped"] = \
+                _fleet_traffic(fleet, threads=2, requests=40,
+                               interval_s=0.02)
+
+        t = _threading.Thread(target=traffic, daemon=True)
+        t.start()
+        aborted = None
+        try:
+            fleet.rolling_update("v2",
+                                 on_replica_updated=corrupt_v2_once)
+        except RolloutAborted as e:
+            aborted = e
+        t.join(300)
+        versions = {rid: s.get("version")
+                    for rid, s in fleet.statuses().items()}
+    finally:
+        fleet.close()
+    assert aborted is not None, "corrupt rollout was not aborted"
+    assert isinstance(aborted.cause, CheckpointIntegrityError), \
+        aborted.cause
+    assert aborted.rolled_back and not aborted.rollback_failed, (
+        aborted.rolled_back, aborted.rollback_failed)
+    assert set(versions.values()) == {"v1"}, versions
+    assert store.current() == "v1"
+    assert verify_step(store.path("v2")) == "corrupt"
+    counts, dropped = background["counts"], background["dropped"]
+    assert counts is not None and not dropped, dropped
+    return {"requests": counts, "dropped": len(dropped),
+            "rolled_back": aborted.rolled_back,
+            "replica_versions": versions,
+            "current_after": store.current(),
+            "faults_fired": {"ckpt.bitrot(v2)": 1}}
+
+
+def scenario_fleet_rollout(tmp: str) -> dict:
+    """The clean zero-downtime rolling update across 3 replicas: the
+    exec cache is pre-warmed, so every replica spin-up performs ZERO
+    XLA compiles (per-replica jax.monitoring listener count over RPC);
+    under concurrent traffic the v1→v2 cutover completes with zero
+    failed requests (router retries absorb the per-replica drain
+    windows)."""
+    os.environ["PERCEIVER_EXEC_CACHE"] = os.path.join(tmp, "exec_cache")
+    store = _fleet_store(tmp, versions=("v1", "v2"))
+
+    # warm the persistent cache in-process with the same spec the
+    # replicas will use: their AOT warmup then deserializes
+    from perceiver_tpu.serving.engine import ServingEngine
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    warm = ServingEngine(MaskedLanguageModelTask(**_FLEET_TASK_KWARGS),
+                         store.load("v1", None),
+                         batch_buckets=(4,), seq_buckets=(16,))
+    assert warm.compile_count <= 1  # at most the one cold compile
+
+    fleet = _start_fleet(tmp, store, replicas=3)
+    try:
+        compiles = {rid: s.get("compile_events")
+                    for rid, s in fleet.statuses().items()}
+        assert len(compiles) == 3, compiles
+
+        import threading as _threading
+
+        background = {}
+
+        def traffic():
+            background["counts"], background["dropped"] = \
+                _fleet_traffic(fleet, threads=3, requests=40,
+                               interval_s=0.02)
+
+        t = _threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let traffic establish before the rollout
+        summary = fleet.rolling_update("v2")
+        t.join(300)
+        versions = {rid: s.get("version")
+                    for rid, s in fleet.statuses().items()}
+    finally:
+        fleet.close()
+    counts, dropped = background["counts"], background["dropped"]
+    assert not dropped, dropped
+    # zero FAILED requests: with siblings always available, retries
+    # absorb every drain window — nothing surfaces even as typed errors
+    assert counts["unavailable"] == 0, counts
+    assert counts["ok"] == 120, counts
+    assert summary["updated"] == 3, summary
+    assert set(versions.values()) == {"v2"}, versions
+    assert store.current() == "v2"
+    # the PR-4 unlock, fleet-wide: replica spin-up compiled NOTHING
+    assert all(c == 0 for c in compiles.values()), compiles
+    return {"requests": counts, "dropped": len(dropped),
+            "rollout": summary, "replica_versions": versions,
+            "spin_up_xla_compiles": compiles,
+            "faults_fired": {}}
+
+
 # scenario name -> (fault plan armed via PERCEIVER_FAULTS, fn)
 _SCENARIOS = {
     "loader_crash": ("loader.exception@at=1,count=2",
@@ -316,10 +620,19 @@ _SCENARIOS = {
     "preempt": ("train.preempt@at=3", scenario_preempt),
     "serve_dispatch": ("serve.dispatch@at=1,count=4",
                        scenario_serve_dispatch),
+    # fleet scenarios arm faults per-REPLICA (supervisor env overrides)
+    # rather than in the scenario child, so the plan column stays None
+    "fleet_kill_replica": (None, scenario_fleet_kill_replica),
+    "fleet_stall": (None, scenario_fleet_stall),
+    "fleet_rollout_corrupt": (None, scenario_fleet_rollout_corrupt),
+    "fleet_rollout": (None, scenario_fleet_rollout),
 }
 _MATRIX = ["loader_crash", "nan_skip", "nan_rewind", "truncated_ckpt",
            "kill_save", "preempt", "serve_dispatch"]
 _FAST = ["nan_skip", "serve_dispatch"]
+_FLEET_MATRIX = ["fleet_kill_replica", "fleet_stall",
+                 "fleet_rollout_corrupt", "fleet_rollout"]
+_FLEET_FAST = ["fleet_kill_replica"]
 
 
 def _run_child(name: str, tmp: str) -> dict:
@@ -345,6 +658,11 @@ def main() -> int:
     ap.add_argument("--fast", action="store_true",
                     help=f"tier-1 subset {_FAST} instead of the full "
                          "matrix")
+    ap.add_argument("--fleet", action="store_true",
+                    help=f"the fleet matrix {_FLEET_MATRIX} (multi-"
+                         "process router/rollout/failover scenarios)")
+    ap.add_argument("--fleet-fast", action="store_true",
+                    help=f"tier-1 fleet subset {_FLEET_FAST}")
     ap.add_argument("--only", nargs="*", default=None,
                     help="run just these scenarios")
     ap.add_argument("--out", default=None,
@@ -360,11 +678,18 @@ def main() -> int:
         from perceiver_tpu.resilience import faults
 
         detail = _SCENARIOS[args.scenario][1](args.tmp)
-        detail["faults_fired"] = faults.counts()
+        # fleet scenarios report fired counts gathered from their
+        # replica processes; don't clobber them with this process's
+        detail.setdefault("faults_fired", faults.counts())
         print(json.dumps(detail, default=str), flush=True)
         return 0
 
-    names = args.only or (_FAST if args.fast else _MATRIX)
+    if args.fleet:
+        names = _FLEET_MATRIX
+    elif args.fleet_fast:
+        names = _FLEET_FAST
+    else:
+        names = args.only or (_FAST if args.fast else _MATRIX)
     unknown = [n for n in names
                if n not in _SCENARIOS or n == "kill_save_victim"]
     if unknown:

@@ -31,3 +31,33 @@ def test_chaos_fast_matrix_survives():
     assert all(ln["value"] == 1.0 for ln in scenarios)
     # the faults really fired (survival by inertness doesn't count)
     assert all(ln["detail"]["faults_fired"] for ln in scenarios)
+
+
+def test_chaos_fleet_fast_survives():
+    """The fleet failover gate (ISSUE 7): kill -9 a replica under
+    live traffic; the supervisor restarts it and the router's
+    retry-on-sibling keeps the dropped-request count at exactly zero.
+    The full matrix (stall ejection, corrupt-rollout auto-rollback,
+    zero-compile rolling update) runs via ``--fleet`` outside tier-1.
+    """
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "chaos.py"),
+         "--fleet-fast"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, f"\n{proc.stdout}\n{proc.stderr}"
+
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    by_metric = {ln["metric"]: ln for ln in lines}
+    for line in lines:
+        assert {"metric", "value", "unit", "vs_baseline",
+                "detail"} <= set(line)
+    assert by_metric["chaos_matrix"]["value"] == 1.0
+    kill = by_metric["chaos_fleet_kill_replica"]
+    assert kill["value"] == 1.0
+    detail = kill["detail"]
+    assert detail["dropped"] == 0  # the headline invariant
+    assert detail["faults_fired"].get("replica.crash", 0) >= 1
+    assert detail["router_retries"] >= 1  # the router actually failed over
+    assert detail["fleet_size_after"] == 3  # crashed replica restarted

@@ -1,0 +1,536 @@
+"""Unit tests for the horizontal serving fleet (perceiver_tpu/fleet/).
+
+Router/autoscaler/rollout logic is tested with fake replica handles
+and an injected clock — no subprocesses, no real engines, no sleeps.
+The RPC layer is tested over real loopback sockets (it is the one
+piece whose behavior lives in the kernel). End-to-end fleet behavior
+(real replica processes, kill -9, rollout corruption) is chaos-gated:
+``scripts/chaos.py --fleet`` (see tests/test_chaos.py for the tier-1
+``--fleet-fast`` gate).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from perceiver_tpu.fleet.autoscaler import Autoscaler
+from perceiver_tpu.fleet.rollout import RolloutAborted, rolling_update
+from perceiver_tpu.fleet.router import Router
+from perceiver_tpu.fleet.rpc import (
+    RpcClient,
+    RpcError,
+    RpcServer,
+    recv_msg,
+    send_msg,
+)
+from perceiver_tpu.resilience.breaker import CLOSED, OPEN
+from perceiver_tpu.serving import RequestTooLarge
+from perceiver_tpu.serving.errors import Unavailable
+from perceiver_tpu.training.checkpoint import (
+    CORRUPT,
+    VERIFIED,
+    CheckpointIntegrityError,
+    ParamsVersionStore,
+)
+
+# --- fakes -------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeHandle:
+    """Scriptable replica handle: a list of outcomes consumed per
+    dispatch — an Exception instance is raised, anything else is the
+    reply; the last entry repeats forever."""
+
+    def __init__(self, outcomes=None, health="READY"):
+        self.outcomes = list(outcomes or [])
+        self.health = health
+        self.dispatches = 0
+        self.updates = []
+
+    def _next(self):
+        if len(self.outcomes) > 1:
+            return self.outcomes.pop(0)
+        return self.outcomes[0] if self.outcomes else None
+
+    def dispatch(self, arrays):
+        self.dispatches += 1
+        outcome = self._next()
+        if isinstance(outcome, Exception):
+            raise outcome
+        if outcome is None:
+            outcome = {"outputs": {"ok": True}, "health": self.health}
+        return outcome
+
+    def status(self):
+        return {"health": self.health}
+
+    def update_version(self, version):
+        self.updates.append(version)
+        return {"version": version}
+
+
+def make_router(**kwargs):
+    clock = FakeClock()
+    kwargs.setdefault("prober_interval_s", None)  # no background thread
+    kwargs.setdefault("retry_backoff_s", 0.0)
+    router = Router(clock=clock, sleep=lambda s: None, **kwargs)
+    return router, clock
+
+
+# --- router ------------------------------------------------------------------
+
+
+def test_router_dispatches_to_single_replica():
+    router, _ = make_router()
+    router.add("a", FakeHandle())
+    reply = router.submit({"x": 1})
+    assert reply["outputs"] == {"ok": True}
+    assert router.metrics.get("fleet_requests_total").value_of(
+        outcome="ok") == 1.0
+
+
+def test_router_retries_transport_failure_on_sibling():
+    router, _ = make_router()
+    bad = FakeHandle([RpcError("boom")])
+    good = FakeHandle()
+    router.add("a", bad)
+    router.add("b", good)
+    reply = router.submit({})
+    assert reply["outputs"] == {"ok": True}
+    assert bad.dispatches + good.dispatches >= 2  # one failed, one served
+    assert router.metrics.get("fleet_retries_total").value_of(
+        cause="transport") == 1.0
+
+
+def test_router_ejects_after_repeated_transport_failures():
+    router, _ = make_router(breaker_failure_threshold=3)
+    bad = FakeHandle([RpcError("down")])
+    good = FakeHandle()
+    router.add("a", bad)
+    router.add("b", good)
+    for _ in range(5):
+        router.submit({})
+    # three strikes opened a's breaker: it stops receiving traffic
+    assert router._replicas["a"].breaker.state == OPEN
+    dispatches_when_open = bad.dispatches
+    for _ in range(5):
+        router.submit({})
+    assert bad.dispatches == dispatches_when_open
+    assert router.metrics.get("fleet_ejections_total").value >= 1.0
+
+
+def test_router_half_open_probe_readmits_recovered_replica():
+    router, clock = make_router(breaker_failure_threshold=2,
+                                breaker_reset_s=1.0)
+    flaky = FakeHandle([RpcError("down"), RpcError("down"), None])
+    router.add("a", flaky)
+    with pytest.raises(Unavailable):
+        router.submit({})
+    assert router._replicas["a"].breaker.state == OPEN
+    clock.advance(1.5)  # past reset: next pick offers the half-open probe
+    reply = router.submit({})
+    assert reply["outputs"] == {"ok": True}
+    assert router._replicas["a"].breaker.state == CLOSED
+
+
+def test_router_replica_unavailable_retries_without_ejecting():
+    router, _ = make_router()
+    swapping = FakeHandle([Unavailable("updating", retry_after_s=0.05)])
+    good = FakeHandle()
+    router.add("a", swapping)
+    router.add("b", good)
+    for _ in range(4):
+        assert router.submit({})["outputs"] == {"ok": True}
+    # typed refusals never feed the breaker — mid-swap is not a fault
+    assert router._replicas["a"].breaker.state == CLOSED
+    assert router.metrics.get("fleet_retries_total").value_of(
+        cause="unavailable") >= 1.0
+
+
+def test_router_fleet_saturated_is_typed_with_retry_hint():
+    router, _ = make_router(max_attempts=2)
+    router.add("a", FakeHandle([Unavailable("updating",
+                                            retry_after_s=0.25)]))
+    with pytest.raises(Unavailable) as exc:
+        router.submit({})
+    assert exc.value.reason == "fleet_saturated"
+    assert exc.value.retry_after_s >= 0.25
+    assert router.metrics.get("fleet_requests_total").value_of(
+        outcome="unavailable") == 1.0
+
+
+def test_router_empty_fleet_is_typed_unavailable():
+    router, _ = make_router(max_attempts=2)
+    with pytest.raises(Unavailable) as exc:
+        router.submit({})
+    assert exc.value.reason == "fleet_saturated"
+    assert exc.value.retry_after_s > 0
+
+
+def test_router_deterministic_error_propagates_untyped():
+    router, _ = make_router()
+    router.add("a", FakeHandle([RequestTooLarge("b=999 exceeds buckets")]))
+    router.add("b", FakeHandle())
+    with pytest.raises(RequestTooLarge):
+        router.submit({})
+
+
+def test_router_drain_excludes_replica_until_undrain():
+    router, _ = make_router()
+    a, b = FakeHandle(), FakeHandle()
+    router.add("a", a)
+    router.add("b", b)
+    router.drain("a")
+    for _ in range(3):
+        router.submit({})
+    assert a.dispatches == 0 and b.dispatches == 3
+    assert router.wait_idle("a", timeout=0.1)
+    router.undrain("a")
+    router.submit({})
+    assert a.dispatches == 1  # back in rotation (least-loaded tie → "a")
+
+
+def test_router_prefers_ready_over_degraded():
+    router, _ = make_router()
+    degraded = FakeHandle(
+        [{"outputs": {"by": "a"}, "health": "DEGRADED"}], health="DEGRADED")
+    ready = FakeHandle([{"outputs": {"by": "b"}, "health": "READY"}])
+    router.add("a", degraded)
+    router.add("b", ready)
+    router._replicas["a"].health = "DEGRADED"
+    for _ in range(4):
+        assert router.submit({})["outputs"] == {"by": "b"}
+    assert degraded.dispatches == 0
+    router.drain("b")
+    assert router.submit({})["outputs"] == {"by": "a"}  # still serves
+
+
+def test_router_remove_forgets_replica():
+    router, _ = make_router()
+    router.add("a", FakeHandle())
+    router.add("b", FakeHandle())
+    router.remove("a")
+    assert router.replicas() == ["b"]
+    assert router.metrics.get("fleet_size").value == 1.0
+
+
+def test_router_occupancy_counts_inflight():
+    router, _ = make_router()
+    release = threading.Event()
+
+    class Blocking(FakeHandle):
+        def dispatch(self, arrays):
+            release.wait(2.0)
+            return super().dispatch(arrays)
+
+    router.add("a", Blocking())
+    t = threading.Thread(target=lambda: router.submit({}))
+    t.start()
+    deadline = 50
+    while router.occupancy() == 0.0 and deadline:
+        deadline -= 1
+        threading.Event().wait(0.01)
+    assert router.occupancy() == 1.0
+    release.set()
+    t.join(2.0)
+    assert router.occupancy() == 0.0
+
+
+# --- autoscaler --------------------------------------------------------------
+
+
+class FakeFleet:
+    def __init__(self, size=2, occupancy=0.0):
+        self._size = size
+        self.occupancy_value = occupancy
+        self.router = self
+
+    def occupancy(self):
+        return self.occupancy_value
+
+    def size(self):
+        return self._size
+
+    def scale_to(self, n):
+        self._size = n
+
+
+def test_autoscaler_scales_up_after_consecutive_high_samples():
+    fleet = FakeFleet(size=2, occupancy=3.0)
+    scaler = Autoscaler(min_replicas=1, max_replicas=4,
+                        scale_up_above=1.5, consecutive=3)
+    scaler.bind(fleet)
+    assert scaler.tick() is None
+    assert scaler.tick() is None
+    assert scaler.tick() == 3  # third consecutive sample triggers
+    assert fleet.size() == 3
+    assert scaler.resizes == [("up", 3)]
+
+
+def test_autoscaler_single_burst_does_not_flap():
+    fleet = FakeFleet(size=2, occupancy=3.0)
+    scaler = Autoscaler(consecutive=3)
+    scaler.bind(fleet)
+    scaler.tick()
+    fleet.occupancy_value = 1.0  # back in band: streak resets
+    scaler.tick()
+    fleet.occupancy_value = 3.0
+    assert scaler.tick() is None and scaler.tick() is None
+    assert fleet.size() == 2
+
+
+def test_autoscaler_scales_down_and_respects_min():
+    fleet = FakeFleet(size=2, occupancy=0.0)
+    scaler = Autoscaler(min_replicas=1, max_replicas=4,
+                        scale_down_below=0.25, consecutive=2)
+    scaler.bind(fleet)
+    assert scaler.tick() is None
+    assert scaler.tick() == 1
+    assert fleet.size() == 1
+    # at the floor: further idle samples never drop below min
+    for _ in range(6):
+        assert scaler.tick() is None
+    assert fleet.size() == 1
+
+
+def test_autoscaler_respects_max():
+    fleet = FakeFleet(size=3, occupancy=9.0)
+    scaler = Autoscaler(max_replicas=3, consecutive=1)
+    scaler.bind(fleet)
+    for _ in range(4):
+        assert scaler.tick() is None
+    assert fleet.size() == 3
+
+
+def test_autoscaler_heals_below_min():
+    fleet = FakeFleet(size=0, occupancy=0.0)  # e.g. poisoned slots
+    scaler = Autoscaler(min_replicas=2, max_replicas=4)
+    scaler.bind(fleet)
+    assert scaler.tick() == 2
+    assert fleet.size() == 2
+
+
+def test_autoscaler_validates_configuration():
+    with pytest.raises(ValueError):
+        Autoscaler(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        Autoscaler(scale_up_above=0.2, scale_down_below=0.5)
+    with pytest.raises(ValueError):
+        Autoscaler(consecutive=0)
+    with pytest.raises(RuntimeError):
+        Autoscaler().tick()  # unbound
+
+
+# --- params version store ----------------------------------------------------
+
+
+def _params(seed):
+    rng = np.random.RandomState(seed)
+    return {"dense": {"w": rng.randn(4, 4).astype(np.float32),
+                      "b": np.zeros((4,), np.float32)}}
+
+
+def test_version_store_publish_and_load(tmp_path):
+    store = ParamsVersionStore(str(tmp_path / "store"))
+    store.publish("v1", _params(0))
+    store.publish("v2", _params(1), set_current=False)
+    assert store.versions() == ["v1", "v2"]
+    assert store.current() == "v1"  # set_current=False left the pointer
+    assert store.verify("v2") == VERIFIED
+    loaded = store.load("v2", _params(0))
+    np.testing.assert_allclose(loaded["dense"]["w"], _params(1)["dense"]["w"])
+    store.set_current("v2")
+    assert store.current() == "v2"
+
+
+def test_version_store_rejects_republish_and_bad_names(tmp_path):
+    store = ParamsVersionStore(str(tmp_path / "store"))
+    store.publish("v1", _params(0))
+    with pytest.raises(FileExistsError):
+        store.publish("v1", _params(1))
+    for bad in ("", "CURRENT", f"up{os.sep}dir"):
+        with pytest.raises(ValueError):
+            store.publish(bad, _params(0))
+    with pytest.raises(FileNotFoundError):
+        store.set_current("v9")
+
+
+def test_version_store_corrupt_version_refuses_to_load(tmp_path):
+    store = ParamsVersionStore(str(tmp_path / "store"))
+    store.publish("v1", _params(0))
+    blobs = []
+    for root, _, names in os.walk(store.path("v1")):
+        blobs.extend(os.path.join(root, n) for n in names
+                     if "manifest" not in n)
+    target = max(blobs, key=os.path.getsize)
+    with open(target, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(target) // 2))
+    assert store.verify("v1") == CORRUPT
+    with pytest.raises(CheckpointIntegrityError):
+        store.load("v1", _params(0))
+
+
+# --- rolling update (fakes) --------------------------------------------------
+
+
+class FakeRolloutRouter:
+    def __init__(self):
+        self.calls = []
+
+    def drain(self, rid):
+        self.calls.append(("drain", rid))
+
+    def wait_idle(self, rid, timeout=10.0):
+        self.calls.append(("wait_idle", rid))
+        return True
+
+    def undrain(self, rid):
+        self.calls.append(("undrain", rid))
+
+
+class FakeSupervisor:
+    def __init__(self, handles, spec):
+        self.handles = handles
+        self.spec = spec
+
+    def replicas(self):
+        return sorted(self.handles)
+
+    def handle_of(self, rid):
+        return self.handles.get(rid)
+
+
+class FakeRolloutFleet:
+    def __init__(self, handles, store_dir, version="v1"):
+        self.spec = {"store_dir": store_dir, "version": version}
+        self.router = FakeRolloutRouter()
+        self.supervisor = FakeSupervisor(handles, dict(self.spec))
+
+
+def _store_with(tmp_path, versions=("v1", "v2")):
+    store = ParamsVersionStore(str(tmp_path / "store"))
+    for i, v in enumerate(versions):
+        store.publish(v, _params(i), set_current=(i == 0))
+    return store
+
+
+def test_rolling_update_updates_all_and_moves_current(tmp_path):
+    store = _store_with(tmp_path)
+    handles = {"r0": FakeHandle(), "r1": FakeHandle(), "r2": FakeHandle()}
+    fleet = FakeRolloutFleet(handles, store.directory)
+    summary = rolling_update(fleet, "v2")
+    assert summary == {"version": "v2", "previous": "v1",
+                       "replicas": ["r0", "r1", "r2"], "updated": 3}
+    assert all(h.updates == ["v2"] for h in handles.values())
+    assert store.current() == "v2"
+    assert fleet.spec["version"] == "v2"
+    assert fleet.supervisor.spec["version"] == "v2"
+    # drain/cutover/undrain ran per replica, in order
+    drains = [rid for op, rid in fleet.router.calls if op == "drain"]
+    assert drains == ["r0", "r1", "r2"]
+
+
+def test_rolling_update_failure_rolls_back_updated_replicas(tmp_path):
+    store = _store_with(tmp_path)
+
+    class FailingHandle(FakeHandle):
+        def update_version(self, version):
+            if version == "v2":
+                raise CheckpointIntegrityError("manifest check failed")
+            return super().update_version(version)
+
+    handles = {"r0": FakeHandle(), "r1": FailingHandle(), "r2": FakeHandle()}
+    fleet = FakeRolloutFleet(handles, store.directory)
+    with pytest.raises(RolloutAborted) as exc:
+        rolling_update(fleet, "v2")
+    assert isinstance(exc.value.cause, CheckpointIntegrityError)
+    assert exc.value.rolled_back == ["r0"]
+    assert exc.value.rollback_failed == []
+    # r0 went v2 then back to v1; r2 was never touched; CURRENT stayed
+    assert handles["r0"].updates == ["v2", "v1"]
+    assert handles["r2"].updates == []
+    assert store.current() == "v1"
+    assert fleet.spec["version"] == "v1"
+    # the failing replica was undrained — it still serves old params
+    undrained = [rid for op, rid in fleet.router.calls if op == "undrain"]
+    assert "r1" in undrained
+
+
+def test_rolling_update_requires_store(tmp_path):
+    fleet = FakeRolloutFleet({"r0": FakeHandle()}, "")
+    fleet.spec["store_dir"] = None
+    with pytest.raises(ValueError):
+        rolling_update(fleet, "v2")
+
+
+# --- rpc layer (real loopback sockets) ---------------------------------------
+
+
+def test_rpc_framed_roundtrip_over_socketpair():
+    import socket
+
+    a, b = socket.socketpair()
+    try:
+        payload = {"arrays": np.arange(6).reshape(2, 3), "op": "dispatch"}
+        send_msg(a, payload, timeout=5.0)
+        got = recv_msg(b, timeout=5.0)
+        assert got["op"] == "dispatch"
+        np.testing.assert_array_equal(got["arrays"], payload["arrays"])
+        a.close()
+        assert recv_msg(b, timeout=5.0) is None  # clean EOF at boundary
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_rpc_client_server_call_and_typed_errors():
+    def handler(request):
+        op = request["op"]
+        if op == "ping":
+            return "pong"
+        if op == "reject":
+            raise Unavailable("updating", retry_after_s=0.25)
+        raise ValueError(f"unknown op {op!r}")
+
+    server = RpcServer(handler)
+    client = RpcClient("127.0.0.1", server.port, timeout=5.0)
+    try:
+        assert client.call("ping") == "pong"
+        with pytest.raises(Unavailable) as exc:
+            client.call("reject")
+        # the typed envelope crossed the wire: reason AND hint survive
+        assert exc.value.reason == "updating"
+        assert exc.value.retry_after_s == 0.25
+        assert client.call("ping") == "pong"  # connection still healthy
+    finally:
+        client.close()
+        server.close()
+
+
+def test_rpc_client_connect_refused_is_rpc_error():
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()  # nothing listens here any more
+    client = RpcClient("127.0.0.1", port, connect_timeout=0.5, timeout=0.5)
+    with pytest.raises(RpcError):
+        client.call("ping")
+    client.close()

@@ -772,6 +772,66 @@ def test_lint_uncached_compile_suppression():
     assert "uncached-compile" not in _checks(suppressed)
 
 
+# --- router-blocking-io ------------------------------------------------------
+
+_FLEET_BLOCKING_RECV = """
+def read_reply(sock):
+    return sock.recv(4096)
+"""
+
+_FLEET_BARE_CONNECT = """
+import socket
+
+def connect(host, port):
+    return socket.create_connection((host, port))
+"""
+
+_FLEET_PATH = "perceiver_tpu/fleet/new_transport.py"
+
+
+def test_lint_router_blocking_io_seeded():
+    assert "router-blocking-io" in _checks(_FLEET_BLOCKING_RECV, _FLEET_PATH)
+    assert "router-blocking-io" in _checks(_FLEET_BARE_CONNECT, _FLEET_PATH)
+    accept = _FLEET_BLOCKING_RECV.replace("recv(4096)", "accept()")
+    assert "router-blocking-io" in _checks(accept, _FLEET_PATH)
+
+
+def test_lint_router_blocking_io_deadline_clears():
+    deadlined = _FLEET_BLOCKING_RECV.replace(
+        "return sock.recv", "sock.settimeout(10.0)\n    return sock.recv")
+    assert not _checks(deadlined, _FLEET_PATH)
+    timed = _FLEET_BARE_CONNECT.replace(
+        "(host, port))", "(host, port), timeout=5.0)")
+    assert not _checks(timed, _FLEET_PATH)
+
+
+def test_lint_router_blocking_io_scoped_to_fleet():
+    # the rule polices the fleet's hot paths only; blocking sockets
+    # elsewhere are some other module's business
+    assert not _checks(_FLEET_BLOCKING_RECV, "perceiver_tpu/data/io.py")
+    assert not _checks(_FLEET_BARE_CONNECT, "scripts/tooling.py")
+
+
+def test_lint_router_blocking_io_suppression():
+    suppressed = _FLEET_BLOCKING_RECV.replace(
+        "sock.recv(4096)",
+        "sock.recv(4096)  # graphcheck: ignore — deadline set by caller")
+    assert "router-blocking-io" not in _checks(suppressed, _FLEET_PATH)
+
+
+def test_lint_fleet_package_is_clean():
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fleet = os.path.join(root, "perceiver_tpu", "fleet")
+    for name in sorted(os.listdir(fleet)):
+        if not name.endswith(".py"):
+            continue
+        rel = f"perceiver_tpu/fleet/{name}"
+        with open(os.path.join(fleet, name)) as f:
+            assert not lint_source(f.read(), rel), rel
+
+
 # --- headline regression + full sweep ---------------------------------------
 
 

@@ -191,6 +191,52 @@ class TestEngine:
         with pytest.raises(ValueError, match="same pytree structure"):
             eng.update_params({"nope": np.zeros(3)})
 
+    def test_update_params_concurrent_dispatch_no_torn_pytree(self):
+        """Dispatches racing ``update_params`` swaps: every result
+        must come entirely from the old params or entirely from the
+        new — a torn (half-swapped) pytree would produce a third
+        output value. This is the replica-side invariant the fleet's
+        rolling update builds on (docs/SERVING.md "Fleet")."""
+        eng = ServingEngine(tiny_mlm_task(), batch_buckets=(1,),
+                            seq_buckets=(16,))
+        arrays = request_arrays(1, 16, seed=7)
+        params_a = eng.graph.init_params(seed=111)
+        params_b = eng.graph.init_params(seed=222)
+        eng.update_params(params_a)
+        out_a = materialize(eng.dispatch(dict(arrays)),
+                            eng.graph)["topk_scores"]
+        eng.update_params(params_b)
+        out_b = materialize(eng.dispatch(dict(arrays)),
+                            eng.graph)["topk_scores"]
+        assert not np.array_equal(out_a, out_b)
+
+        torn, errors = [], []
+
+        def dispatcher():
+            try:
+                for _ in range(20):
+                    got = materialize(eng.dispatch(dict(arrays)),
+                                      eng.graph)["topk_scores"]
+                    if not (np.array_equal(got, out_a)
+                            or np.array_equal(got, out_b)):
+                        torn.append(got)
+                        return
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        threads = [threading.Thread(target=dispatcher) for _ in range(4)]
+        for t in threads:
+            t.start()
+        swaps = 0
+        while any(t.is_alive() for t in threads):
+            eng.update_params(params_a if swaps % 2 == 0 else params_b)
+            swaps += 1
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        assert not torn, "a dispatch saw a torn params pytree"
+        assert swaps >= 2  # the race actually raced
+
     def test_checkpoint_restore_roundtrip(self, tmp_path):
         from perceiver_tpu.training.checkpoint import save_params
 
@@ -286,6 +332,65 @@ class TestMicroBatcher:
                 reason="deadline") == 1
         finally:
             mb.close()
+
+    def test_drain_waits_for_queued_and_inflight(self):
+        release = threading.Event()
+
+        def runner(items):
+            release.wait(5)
+            return items
+
+        mb = MicroBatcher(runner, max_batch=2, max_delay_ms=0,
+                          max_depth=16)
+        try:
+            futs = [mb.submit(i) for i in range(6)]
+            # a batch is wedged inside the runner: drain must time out,
+            # not report idle while requests are unresolved
+            assert not mb.drain(timeout=0.1)
+            release.set()
+            assert mb.drain(timeout=10)
+            assert mb.depth == 0 and mb.inflight == 0
+            assert [f.result(timeout=1) for f in futs] == list(range(6))
+        finally:
+            mb.close()
+
+    def test_close_is_idempotent_and_resolves_every_future(self):
+        def runner(items):
+            time.sleep(0.005)
+            return [x * 2 for x in items]
+
+        mb = MicroBatcher(runner, max_batch=4, max_delay_ms=5,
+                          max_depth=32)
+        futs = [mb.submit(i) for i in range(8)]
+        mb.close()
+        # close drains: every accepted request resolved with a result
+        assert [f.result(timeout=1) for f in futs] == [
+            i * 2 for i in range(8)]
+        mb.close()  # second close returns immediately, no error
+        mb.close()
+
+    def test_close_fails_stranded_futures_typed_when_runner_wedged(self):
+        from perceiver_tpu.serving.errors import Unavailable
+
+        wedge = threading.Event()
+
+        def runner(items):
+            wedge.wait(30)  # far past close()'s timeout
+            return items
+
+        mb = MicroBatcher(runner, max_batch=1, max_delay_ms=0,
+                          max_depth=16)
+        futs = [mb.submit(i) for i in range(4)]
+        time.sleep(0.05)  # let the worker wedge on the first batch
+        mb.close(timeout=0.2)
+        stranded = 0
+        for f in futs:
+            if f.done() and f.exception() is not None:
+                assert isinstance(f.exception(), Unavailable)
+                assert f.exception().reason == "shutting_down"
+                stranded += 1
+        assert stranded >= 1  # queued-but-unserved futures got typed
+        wedge.set()  # unwedge so the daemon worker exits
 
     def test_runner_error_fails_batch_not_worker(self):
         calls = []
@@ -408,6 +513,18 @@ class TestMLMServerEndToEnd:
         after = server.metrics.get("serving_shed_total").value_of(
             reason="deadline")
         assert after - before == len(shed)
+
+    def test_close_drains_then_is_idempotent(self, server):
+        """Must run last in this class (it closes the shared server):
+        close() resolves every accepted request before tearing the
+        worker down, and repeat closes (the fixture teardown makes a
+        third) are no-ops."""
+        futs = [server.submit("the [MASK] dog") for _ in range(4)]
+        server.close()
+        for f in futs:
+            r = f.result(timeout=1)  # resolved, not stranded
+            assert isinstance(r, Overloaded) or r.predictions
+        server.close()  # idempotent
 
 
 class TestPredictCompat:
