@@ -37,3 +37,14 @@ class TextInputAdapter:
         emb = jnp.take(policy.cast_param(params["embed"]), x, axis=0)
         pos = policy.cast_param(params["pos"][:l])
         return emb * jnp.asarray(scale, policy.compute_dtype) + pos[None]
+
+    def apply_packed(self, params, ids, positions, *,
+                     policy: Policy = DEFAULT_POLICY):
+        """Embed a packed (T,) token axis: each token looks up its own
+        in-request position instead of its index in the packed buffer
+        — the ragged serve path's replacement for ``apply``'s implicit
+        ``arange(l)`` positions. Returns (T, C)."""
+        scale = math.sqrt(self.num_input_channels)
+        emb = jnp.take(policy.cast_param(params["embed"]), ids, axis=0)
+        pos = jnp.take(policy.cast_param(params["pos"]), positions, axis=0)
+        return emb * jnp.asarray(scale, policy.compute_dtype) + pos

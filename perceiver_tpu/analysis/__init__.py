@@ -33,10 +33,12 @@ from perceiver_tpu.analysis.passes import (  # noqa: F401
 from perceiver_tpu.analysis.targets import (  # noqa: F401
     CANONICAL_TARGETS,
     FAST_TARGETS,
+    PACKED_SERVING_TARGETS,
     SERVING_TARGETS,
     StepTarget,
     cost_bytes_accessed,
     lower_target,
+    make_packed_serve_step,
     make_serve_step,
     make_train_step,
 )

@@ -150,6 +150,24 @@ def make_serve_step(task, batch):
     return jitted, args, expected
 
 
+def make_packed_serve_step(task, batch):
+    """The packed ragged serve-graph jit for a task — the executable
+    ``ServingEngine.dispatch_packed`` AOT-compiles per token-budget
+    bucket. Returns ``(jitted_fn, args, expected_donated)``: the MLM
+    packed graph donates ``packed_ids`` (aliases ``filled_ids``)."""
+    import jax
+
+    from perceiver_tpu.serving.graphs import build_packed_serve_graph
+
+    graph = build_packed_serve_graph(task)
+    params = graph.init_params()
+    args = (params,) + tuple(batch[spec.name] for spec in graph.inputs)
+    jitted = jax.jit(graph.fn, donate_argnums=graph.donate_argnums)
+    donated_args = tuple(args[i] for i in graph.donate_argnums)
+    expected = len(jax.tree_util.tree_leaves(donated_args))
+    return jitted, args, expected
+
+
 def lower_target(target: StepTarget, cache=None) -> LoweredStep:
     """Build the target's task + batch, lower its step (train or
     serve), and package the properties the graph passes gate on.
@@ -177,6 +195,8 @@ def lower_target(target: StepTarget, cache=None) -> LoweredStep:
     task, batch = target.build()
     if target.kind == "serve":
         step, args, expected = make_serve_step(task, batch)
+    elif target.kind == "packed_serve":
+        step, args, expected = make_packed_serve_step(task, batch)
     else:
         step, args = make_train_step(task, batch)
         params, opt_state = args[0], args[1]
@@ -361,6 +381,72 @@ SERVING_TARGETS = (
 )
 
 
+# Packed (ragged) serving targets: the mixed-length headline workload
+# — the same 32 requests serve_mlm_b32_s512 pads to a (32, 512)
+# rectangle, packed into one 8192-token buffer (7680 real tokens,
+# lengths cycling 64/128/256/512). The hbm_budget pin on these targets
+# IS the merge gate for the padding-free claim: the packed executable
+# must stay ≥ 25% below the rectangular equivalent's pinned bytes
+# (tests/test_graphcheck.py).
+
+def _packed_serve_lengths(rows: int):
+    import numpy as np
+
+    return np.array([(64, 128, 256, 512)[i % 4] for i in range(rows)],
+                    np.int32)
+
+
+def _packed_serve_batch(rows: int, tokens: int, vocab: int,
+                        mask_every: int = 0):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_tpu.tokenizer import MASK_TOKEN_ID, PAD_TOKEN_ID
+
+    lens = _packed_serve_lengths(rows)
+    total = int(lens.sum())
+    if total > tokens:
+        raise ValueError(f"lengths sum {total} exceeds bucket {tokens}")
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, vocab, (tokens,))
+    if mask_every:
+        ids[::mask_every] = MASK_TOKEN_ID
+    ids[total:] = PAD_TOKEN_ID
+    offs = np.zeros(rows, np.int32)
+    offs[1:] = np.cumsum(lens)[:-1]
+    return {
+        "packed_ids": jnp.asarray(ids, jnp.int32),
+        "row_offsets": jnp.asarray(offs, jnp.int32),
+        "lengths": jnp.asarray(lens, jnp.int32),
+    }
+
+
+def _serve_batch_mlm_packed(tokens: int = 8192, rows: int = 32,
+                            vocab: int = 10003, channels: int = 64):
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    task = MaskedLanguageModelTask(
+        vocab_size=vocab, max_seq_len=512, num_latent_channels=channels)
+    # same representative fill-mask density as _serve_batch_mlm
+    return task, _packed_serve_batch(rows, tokens, vocab, mask_every=7)
+
+
+def _serve_batch_text_clf_packed(tokens: int = 8192, rows: int = 32,
+                                 vocab: int = 10003):
+    from perceiver_tpu.tasks import TextClassifierTask
+
+    task = TextClassifierTask(vocab_size=vocab, max_seq_len=512)
+    return task, _packed_serve_batch(rows, tokens, vocab)
+
+
+PACKED_SERVING_TARGETS = (
+    StepTarget(name="serve_mlm_packed_t8192_r32",
+               build=_serve_batch_mlm_packed, kind="packed_serve"),
+    StepTarget(name="serve_text_clf_packed_t8192_r32",
+               build=_serve_batch_text_clf_packed, kind="packed_serve"),
+)
+
+
 # The headline MLM rung (bench.py _LADDER[0]: B=512/C=64/packed) plus
 # one target per remaining task at its canonical shapes, plus the
 # serving targets. "fast" targets keep tracing under a few seconds for
@@ -372,7 +458,7 @@ CANONICAL_TARGETS = (
     StepTarget(name="text_clf_b64", build=_build_text_clf),
     StepTarget(name="img_clf_b512", build=_build_img_clf),
     StepTarget(name="seg_512x512_b1", build=_build_seg),
-) + SERVING_TARGETS
+) + SERVING_TARGETS + PACKED_SERVING_TARGETS
 
 FAST_TARGETS = tuple(t for t in CANONICAL_TARGETS
                      if t.name != "seg_512x512_b1")

@@ -10,7 +10,12 @@ on stdout so the supervisor can connect.
 
 RPC ops (see ``fleet/rpc.py`` for the envelope):
 
-``dispatch``        host arrays in, materialized host outputs out
+``dispatch``        host arrays in, materialized host outputs out.
+                    A payload carrying ``packed_ids`` routes to the
+                    engine's ragged ``dispatch_packed`` path (spec key
+                    ``packed_buckets`` enables it) — the router and
+                    RPC envelope are payload-agnostic, so packed and
+                    rectangular replicas interchange freely
 ``status``          health/readiness, in-flight, version, compile
                     count, breaker summary, fired fault counts
 ``update_version``  the rolling-update cutover (below)
@@ -44,7 +49,7 @@ from typing import Optional
 
 from perceiver_tpu.fleet.rpc import RpcServer
 from perceiver_tpu.resilience import faults
-from perceiver_tpu.serving.api import materialize
+from perceiver_tpu.serving.api import materialize, materialize_packed
 from perceiver_tpu.serving.errors import Unavailable
 
 
@@ -96,6 +101,8 @@ class ReplicaServer:
             task, params,
             batch_buckets=tuple(spec.get("batch_buckets", (4,))),
             seq_buckets=tuple(spec.get("seq_buckets", (16,))),
+            packed_buckets=tuple(
+                tuple(tb) for tb in spec.get("packed_buckets", ())),
             breaker_failure_threshold=spec.get(
                 "breaker_failure_threshold", 5),
             breaker_reset_s=spec.get("breaker_reset_s", 30.0))
@@ -151,8 +158,13 @@ class ReplicaServer:
         try:
             faults.maybe_stall("replica.stall")
             faults.maybe_kill("replica.crash")
-            result = self.engine.dispatch(arrays)
-            outputs = materialize(result, self.engine.graph)
+            if "packed_ids" in arrays:
+                result = self.engine.dispatch_packed(arrays)
+                outputs = materialize_packed(result,
+                                             self.engine.packed_graph)
+            else:
+                result = self.engine.dispatch(arrays)
+                outputs = materialize(result, self.engine.graph)
         finally:
             with self._lock:
                 self._inflight -= 1

@@ -22,6 +22,7 @@ Layers, bottom to top:
 from perceiver_tpu.serving.batcher import (  # noqa: F401
     MicroBatcher,
     Overloaded,
+    TokenBudgetBatcher,
 )
 from perceiver_tpu.serving.errors import (  # noqa: F401
     BatchError,
@@ -33,12 +34,15 @@ from perceiver_tpu.serving.health import (  # noqa: F401
     HealthState,
 )
 from perceiver_tpu.serving.engine import (  # noqa: F401
+    PackedServeResult,
     RequestTooLarge,
     ServeResult,
     ServingEngine,
 )
 from perceiver_tpu.serving.graphs import (  # noqa: F401
+    PackedServeGraph,
     ServeGraph,
+    build_packed_serve_graph,
     build_serve_graph,
     mlm_serve_graph,
 )
@@ -49,4 +53,5 @@ from perceiver_tpu.serving.api import (  # noqa: F401
     SegmentationServer,
     TextClassifierServer,
     materialize,
+    materialize_packed,
 )

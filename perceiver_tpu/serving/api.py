@@ -23,8 +23,16 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
-from perceiver_tpu.serving.batcher import MicroBatcher, Overloaded
-from perceiver_tpu.serving.engine import ServeResult, ServingEngine
+from perceiver_tpu.serving.batcher import (
+    MicroBatcher,
+    Overloaded,
+    TokenBudgetBatcher,
+)
+from perceiver_tpu.serving.engine import (
+    PackedServeResult,
+    ServeResult,
+    ServingEngine,
+)
 from perceiver_tpu.serving.graphs import mlm_serve_graph
 from perceiver_tpu.serving.metrics import MetricsRegistry
 from perceiver_tpu.tokenizer import PAD_TOKEN_ID
@@ -45,26 +53,65 @@ def materialize(result: ServeResult, graph=None) -> Dict[str, np.ndarray]:
     return out
 
 
+def materialize_packed(result: PackedServeResult,
+                       graph) -> Dict[str, np.ndarray]:
+    """Device outputs of a packed dispatch → host arrays: token-axis
+    outputs sliced to the real packed span (per-request slicing then
+    uses ``row_offsets``/``lengths``), request-axis outputs to the real
+    rows."""
+    total = int(np.asarray(result.lengths).sum())
+    token_axis = set(graph.token_axis_outputs)
+    out = {}
+    for name, arr in result.outputs.items():
+        host = np.asarray(arr)
+        out[name] = (host[:total] if name in token_axis
+                     else host[:result.batch])
+    return out
+
+
 class _Server:
     """Engine + micro-batcher plumbing shared by the task servers."""
 
     def __init__(self, engine: ServingEngine, *,
                  max_batch: Optional[int] = None,
-                 max_delay_ms: float = 2.0, max_depth: int = 64):
+                 max_delay_ms: float = 2.0, max_depth: int = 64,
+                 packed: bool = False):
         self.engine = engine
         self.metrics: MetricsRegistry = engine.metrics
-        if max_batch is None:
-            max_batch = (engine.batch_buckets[-1]
-                         if engine.batch_buckets else 8)
-        self.batcher = MicroBatcher(
-            self._run_batch, max_batch=max_batch,
-            max_delay_ms=max_delay_ms, max_depth=max_depth,
-            metrics=self.metrics)
+        self.packed = packed
+        if packed:
+            # continuous batching: coalesce by real-token budget (the
+            # largest packed bucket) instead of request count
+            if not engine.packed_buckets:
+                raise ValueError(
+                    "packed=True needs an engine built with "
+                    "packed_buckets")
+            token_budget = max(t for t, _ in engine.packed_buckets)
+            if max_batch is None:
+                max_batch = max(r for _, r in engine.packed_buckets)
+            self.batcher: MicroBatcher = TokenBudgetBatcher(
+                self._run_batch, token_budget=token_budget,
+                cost_fn=self._payload_cost, max_requests=max_batch,
+                max_delay_ms=max_delay_ms, max_depth=max_depth,
+                metrics=self.metrics)
+        else:
+            if max_batch is None:
+                max_batch = (engine.batch_buckets[-1]
+                             if engine.batch_buckets else 8)
+            self.batcher = MicroBatcher(
+                self._run_batch, max_batch=max_batch,
+                max_delay_ms=max_delay_ms, max_depth=max_depth,
+                metrics=self.metrics)
         self._close_lock = threading.Lock()
         self._closed = False
 
     def _run_batch(self, payloads: List[object]) -> Sequence[object]:
         raise NotImplementedError
+
+    def _payload_cost(self, payload) -> int:
+        """Token cost of one queued payload (packed mode). Text servers
+        tokenize at submit, so the payload carries its length."""
+        return int(payload[2])
 
     @property
     def health(self):
@@ -101,6 +148,16 @@ class _Server:
         self.batcher.close(timeout)
 
 
+def _pack_rows(payloads: List[object]):
+    """(text, ids, length) payloads → packed token buffer + spans."""
+    lengths = np.array([p[2] for p in payloads], np.int32)
+    offsets = np.zeros(len(payloads), np.int32)
+    if len(payloads) > 1:
+        offsets[1:] = np.cumsum(lengths[:-1])
+    packed = np.concatenate([p[1] for p in payloads])
+    return packed.astype(np.int32, copy=False), offsets, lengths
+
+
 @dataclasses.dataclass(frozen=True)
 class MaskFill:
     """Fill-mask result for one request.
@@ -126,8 +183,12 @@ class MLMServer(_Server):
         if not engine.graph.seq_bucketable:
             raise ValueError("MLMServer needs a text-task engine")
         self.tokenizer = tokenizer
-        self._encode_len = (engine.seq_buckets[-1] if engine.seq_buckets
-                            else engine.graph.max_seq_len)
+        if self.packed:
+            self._encode_len = engine.packed_graph.max_seq_len
+        else:
+            self._encode_len = (engine.seq_buckets[-1]
+                                if engine.seq_buckets
+                                else engine.graph.max_seq_len)
 
     def fill_mask(self, text: str, *,
                   timeout_ms: Optional[float] = None) -> MaskFill:
@@ -137,9 +198,20 @@ class MLMServer(_Server):
         return self.submit(text, timeout_ms=timeout_ms).result()
 
     def submit(self, text: str, *, timeout_ms: Optional[float] = None):
-        return self.batcher.submit(text, timeout_ms=timeout_ms)
+        if not self.packed:
+            return self.batcher.submit(text, timeout_ms=timeout_ms)
+        # packed mode tokenizes at submit: the batcher needs each
+        # request's token cost to do budget-based coalescing
+        ids, lengths = self.tokenizer.encode_batch_padded(
+            [text], self._encode_len, pad_id=PAD_TOKEN_ID)
+        n = max(1, int(lengths[0]))
+        row = ids[0, :n].astype(np.int32, copy=False)
+        return self.batcher.submit((text, row, n), timeout_ms=timeout_ms)
 
-    def _run_batch(self, texts: List[str]) -> List[MaskFill]:
+    def _run_batch(self, payloads: List[object]) -> List[MaskFill]:
+        if self.packed:
+            return self._run_packed(payloads)
+        texts = payloads
         # batch tokenization on the worker thread: one GIL-free C++
         # call for the whole micro-batch (tokenizer/native.py)
         ids, lengths = self.tokenizer.encode_batch_padded(
@@ -149,29 +221,48 @@ class MLMServer(_Server):
         pad_mask = np.arange(width)[None, :] >= lengths[:, None]
         res = self.engine.dispatch(
             {"input_ids": ids.astype(np.int32, copy=False),
-             "pad_mask": pad_mask})
+             "pad_mask": pad_mask},
+            lengths=lengths)
         out = materialize(res, self.engine.graph)
         results = []
         for i, text in enumerate(texts):
             n = int(lengths[i])
-            row_ids = ids[i, :n]
-            masked = np.nonzero(out["is_masked"][i, :n])[0]
-            topk_ids = out["topk_ids"][i, :n]
-            topk_scores = out["topk_scores"][i, :n]
-            k = topk_ids.shape[-1]
-            preds = []
-            for j in range(k):
-                filled = np.where(out["is_masked"][i, :n],
-                                  topk_ids[:, j], row_ids)
-                preds.append(self.tokenizer.decode(filled.tolist()))
-            results.append(MaskFill(
-                text=text, predictions=preds,
-                masked_positions=[int(p) for p in masked],
-                topk_tokens=[[self.tokenizer.id_to_token(int(t))
-                              for t in topk_ids[p]] for p in masked],
-                topk_scores=[[float(s) for s in topk_scores[p]]
-                             for p in masked]))
+            results.append(self._mask_fill(
+                text, ids[i, :n], out["is_masked"][i, :n],
+                out["topk_ids"][i, :n], out["topk_scores"][i, :n]))
         return results
+
+    def _run_packed(self, payloads: List[object]) -> List[MaskFill]:
+        packed, offsets, lengths = _pack_rows(payloads)
+        res = self.engine.dispatch_packed(
+            {"packed_ids": packed, "row_offsets": offsets,
+             "lengths": lengths})
+        out = materialize_packed(res, self.engine.packed_graph)
+        results = []
+        for i, (text, row_ids, n) in enumerate(payloads):
+            s = int(offsets[i])
+            results.append(self._mask_fill(
+                text, row_ids, out["is_masked"][s:s + n],
+                out["topk_ids"][s:s + n], out["topk_scores"][s:s + n]))
+        return results
+
+    def _mask_fill(self, text, row_ids, is_masked, topk_ids,
+                   topk_scores) -> MaskFill:
+        """Per-request decode shared by both dispatch modes: inputs are
+        1-D over the request's real tokens."""
+        masked = np.nonzero(is_masked)[0]
+        k = topk_ids.shape[-1]
+        preds = []
+        for j in range(k):
+            filled = np.where(is_masked, topk_ids[:, j], row_ids)
+            preds.append(self.tokenizer.decode(filled.tolist()))
+        return MaskFill(
+            text=text, predictions=preds,
+            masked_positions=[int(p) for p in masked],
+            topk_tokens=[[self.tokenizer.id_to_token(int(t))
+                          for t in topk_ids[p]] for p in masked],
+            topk_scores=[[float(s) for s in topk_scores[p]]
+                         for p in masked])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -185,30 +276,51 @@ class TextClassifierServer(_Server):
     def __init__(self, engine: ServingEngine, tokenizer, **kwargs):
         super().__init__(engine, **kwargs)
         self.tokenizer = tokenizer
-        self._encode_len = (engine.seq_buckets[-1] if engine.seq_buckets
-                            else engine.graph.max_seq_len)
+        if self.packed:
+            self._encode_len = engine.packed_graph.max_seq_len
+        else:
+            self._encode_len = (engine.seq_buckets[-1]
+                                if engine.seq_buckets
+                                else engine.graph.max_seq_len)
 
     def classify(self, text: str, *,
                  timeout_ms: Optional[float] = None) -> Classification:
         return self.submit(text, timeout_ms=timeout_ms).result()
 
     def submit(self, text: str, *, timeout_ms: Optional[float] = None):
-        return self.batcher.submit(text, timeout_ms=timeout_ms)
-
-    def _run_batch(self, texts: List[str]) -> List[Classification]:
+        if not self.packed:
+            return self.batcher.submit(text, timeout_ms=timeout_ms)
         ids, lengths = self.tokenizer.encode_batch_padded(
-            texts, self._encode_len, pad_id=PAD_TOKEN_ID)
-        width = max(1, int(lengths.max()))
-        ids = ids[:, :width]
-        pad_mask = np.arange(width)[None, :] >= lengths[:, None]
-        res = self.engine.dispatch(
-            {"input_ids": ids.astype(np.int32, copy=False),
-             "pad_mask": pad_mask})
-        out = materialize(res, self.engine.graph)
+            [text], self._encode_len, pad_id=PAD_TOKEN_ID)
+        n = max(1, int(lengths[0]))
+        row = ids[0, :n].astype(np.int32, copy=False)
+        return self.batcher.submit((text, row, n), timeout_ms=timeout_ms)
+
+    def _run_batch(self, payloads: List[object]) -> List[Classification]:
+        if self.packed:
+            packed, offsets, lengths = _pack_rows(payloads)
+            res = self.engine.dispatch_packed(
+                {"packed_ids": packed, "row_offsets": offsets,
+                 "lengths": lengths})
+            out = materialize_packed(res, self.engine.packed_graph)
+            n = len(payloads)
+        else:
+            texts = payloads
+            ids, lengths = self.tokenizer.encode_batch_padded(
+                texts, self._encode_len, pad_id=PAD_TOKEN_ID)
+            width = max(1, int(lengths.max()))
+            ids = ids[:, :width]
+            pad_mask = np.arange(width)[None, :] >= lengths[:, None]
+            res = self.engine.dispatch(
+                {"input_ids": ids.astype(np.int32, copy=False),
+                 "pad_mask": pad_mask},
+                lengths=lengths)
+            out = materialize(res, self.engine.graph)
+            n = len(texts)
         return [Classification(label=int(out["label"][i]),
                                probs=out["probs"][i],
                                logits=out["logits"][i])
-                for i in range(len(texts))]
+                for i in range(n)]
 
 
 class ImageClassifierServer(_Server):

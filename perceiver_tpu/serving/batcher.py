@@ -264,3 +264,66 @@ class MicroBatcher:
             self._m_latency.observe(done - p.enqueued_at)
             self._m_served.labels(outcome="ok").inc()
             p.future.set_result(r)
+
+
+class TokenBudgetBatcher(MicroBatcher):
+    """Continuous batching by token budget instead of request count.
+
+    Requests join the in-flight batch until adding the next queued
+    request would exceed ``token_budget`` real tokens
+    (``cost_fn(payload)`` tokens each) — so short requests stop waiting
+    for request-count slots and long requests stop dragging padding
+    along. ``max_requests`` caps the row axis (the packed bucket's
+    request dimension). The first request of a batch is always taken
+    even if it alone exceeds the budget: the engine's packed-bucket
+    check is the authority on servable sizes and raises the typed
+    error the caller should see.
+
+    Everything else — deadline shedding, ``drain()``, ``close()``,
+    batch-failure isolation, every metric — is inherited unchanged
+    from ``MicroBatcher``.
+    """
+
+    def __init__(self, runner: Callable[[List[object]], Sequence[object]],
+                 *, token_budget: int,
+                 cost_fn: Callable[[object], int],
+                 max_requests: int = 64, max_delay_ms: float = 2.0,
+                 max_depth: int = 64,
+                 metrics: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if token_budget < 1:
+            raise ValueError("token_budget must be >= 1")
+        self.token_budget = token_budget
+        self.cost_fn = cost_fn
+        super().__init__(runner, max_batch=max_requests,
+                         max_delay_ms=max_delay_ms, max_depth=max_depth,
+                         metrics=metrics, clock=clock)
+
+    def _take_batch(self) -> Optional[List[_Pending]]:
+        """Block for the first request, then gather while the budget
+        (and row cap) allow, until ``max_delay`` past the first. The
+        head request that would overflow stays queued and seeds the
+        next batch — submission order is preserved."""
+        with self._not_empty:
+            while not self._queue and not self._closed:
+                self._not_empty.wait(0.1)
+            if not self._queue:
+                return None  # closed
+            batch = [self._queue.popleft()]
+            spent = self.cost_fn(batch[0].payload)
+            batch_deadline = self._clock() + self.max_delay
+            while len(batch) < self.max_batch:
+                if self._queue:
+                    cost = self.cost_fn(self._queue[0].payload)
+                    if spent + cost > self.token_budget:
+                        break
+                    batch.append(self._queue.popleft())
+                    spent += cost
+                    continue
+                remaining = batch_deadline - self._clock()
+                if remaining <= 0 or self._closed:
+                    break
+                self._not_empty.wait(remaining)
+            self._m_depth.set(len(self._queue))
+            self._inflight = len(batch)
+            return batch

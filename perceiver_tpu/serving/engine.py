@@ -55,7 +55,12 @@ from perceiver_tpu.resilience.breaker import (
     CircuitBreaker,
 )
 from perceiver_tpu.serving.errors import Unavailable
-from perceiver_tpu.serving.graphs import ServeGraph, build_serve_graph
+from perceiver_tpu.serving.graphs import (
+    PackedServeGraph,
+    ServeGraph,
+    build_packed_serve_graph,
+    build_serve_graph,
+)
 from perceiver_tpu.serving.health import HealthMonitor, HealthState
 from perceiver_tpu.serving.metrics import MetricsRegistry
 
@@ -84,6 +89,25 @@ class ServeResult:
     batch: int
     length: Optional[int]
     bucket: Tuple[int, Optional[int]]
+    # per-request true lengths (host int array), when the caller knows
+    # them — they drive the true-waste metrics and let materialize
+    # slice each row to its real span instead of the batch width
+    lengths: Optional[object] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedServeResult:
+    """One packed (ragged) dispatch, still on device.
+
+    ``outputs`` are token-budget-bucket shaped; ``row_offsets`` /
+    ``lengths`` (host int arrays, ``batch`` real rows) say which spans
+    of the packed token axis are real."""
+
+    outputs: Dict[str, object]
+    batch: int
+    lengths: object
+    row_offsets: object
+    bucket: Tuple[object, int, int]  # ("packed", tokens, rows)
 
 
 class ServingEngine:
@@ -101,6 +125,8 @@ class ServingEngine:
                  warmup: bool = True,
                  exec_cache=None,
                  seed: int = 0,
+                 packed_buckets: Optional[Sequence[Tuple[int, int]]] = None,
+                 packed_graph: Optional[PackedServeGraph] = None,
                  breaker_failure_threshold: int = 5,
                  breaker_reset_s: float = 30.0,
                  breaker_clock=time.monotonic):
@@ -146,6 +172,33 @@ class ServingEngine:
                     "sequence axis; pass seq_buckets")
         else:
             self.seq_buckets = (None,)
+        # packed (ragged) dispatch mode: fixed (token-budget, max-rows)
+        # buckets over the concatenated token axis — seq-bucketable
+        # tasks only, negotiated per task; rectangles stay the fallback
+        self.packed_graph = packed_graph
+        if packed_buckets:
+            if self.packed_graph is None:
+                if task is None:
+                    raise ValueError(
+                        "packed_buckets needs a task config or an "
+                        "explicit packed_graph")
+                if not self.graph.seq_bucketable:
+                    raise ValueError(
+                        f"task kind {self.graph.kind!r} has fixed-shape "
+                        "inputs; packed mode applies to seq-bucketable "
+                        "tasks only")
+                self.packed_graph = build_packed_serve_graph(
+                    task, policy=policy, top_k=top_k)
+            self.packed_buckets = tuple(sorted(
+                set((int(t), int(r)) for t, r in packed_buckets)))
+            bad = [tb for tb in self.packed_buckets
+                   if tb[0] < 1 or tb[1] < 1 or tb[0] < tb[1]]
+            if bad:
+                raise ValueError(
+                    f"invalid packed_buckets {bad}: need tokens >= "
+                    "rows >= 1 (every real row holds >= 1 token)")
+        else:
+            self.packed_buckets = ()
         self.allow_unlisted_buckets = allow_unlisted_buckets
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self._init_metrics()
@@ -223,6 +276,10 @@ class ServingEngine:
             "serving_padding_waste_fraction",
             "padded elements / bucket elements per dispatch",
             buckets=_RATIO_BUCKETS)
+        self._m_padded_tokens = m.counter(
+            "serving_padded_tokens_total",
+            "absolute pad tokens dispatched, by mode (rect|packed) — "
+            "waste attributable in tokens, not just fractions")
         self._m_buckets = m.gauge(
             "serving_compiled_buckets", "compiled bucket executables")
         self._m_exec_hits = m.counter(
@@ -270,8 +327,10 @@ class ServingEngine:
     @property
     def compiled_buckets(self) -> Tuple[Tuple[int, Optional[int]], ...]:
         with self._exe_lock:
-            return tuple(sorted(self._exe,
-                                key=lambda k: (k[0], k[1] or 0)))
+            rect = [k for k in self._exe if k[0] != "packed"]
+            packed = [k for k in self._exe if k[0] == "packed"]
+        return tuple(sorted(rect, key=lambda k: (k[0], k[1] or 0))
+                     + sorted(packed, key=lambda k: k[1:]))
 
     @property
     def compile_count(self) -> int:
@@ -282,9 +341,20 @@ class ServingEngine:
         request that fits a bucket dispatches with zero XLA compiles."""
         for bucket in self.buckets:
             self._ensure_executable(bucket, phase="warmup")
+        for tokens, rows in self.packed_buckets:
+            self._ensure_executable(("packed", tokens, rows),
+                                    phase="warmup")
+
+    def _graph_for(self, bucket):
+        return self.packed_graph if bucket[0] == "packed" else self.graph
 
     def _input_structs(self, bucket):
         import jax
+        if bucket[0] == "packed":
+            _, tokens, rows = bucket
+            return tuple(
+                jax.ShapeDtypeStruct(spec.shape(tokens, rows), spec.dtype)
+                for spec in self.packed_graph.inputs)
         b, s = bucket
         return tuple(
             jax.ShapeDtypeStruct(spec.shape(b, s), spec.dtype)
@@ -296,17 +366,17 @@ class ServingEngine:
         if exe is not None:
             return exe
         import jax
-        jitted = jax.jit(self.graph.fn,
-                         donate_argnums=self.graph.donate_argnums)
+        graph = self._graph_for(bucket)
+        jitted = jax.jit(graph.fn,
+                         donate_argnums=graph.donate_argnums)
         # on an exec-cache hit this deserializes the stored executable
         # — no XLA compile at all; on a miss it compiles once and
         # stores the blob for the next process
         exe, info = aot_compile(
             jitted, (self._params, *self._input_structs(bucket)),
             cache=self.exec_cache,
-            donate_argnums=self.graph.donate_argnums,
-            label=f"serve:{self.graph.kind}:b{bucket[0]}"
-                  + (f"_s{bucket[1]}" if bucket[1] else ""))
+            donate_argnums=graph.donate_argnums,
+            label=f"serve:{graph.kind}:{self._bucket_name(bucket)}")
         if self.exec_cache is not None:
             if info["hit"]:
                 self._m_exec_hits.inc()
@@ -357,6 +427,8 @@ class ServingEngine:
     # -- failure handling -------------------------------------------------
 
     def _bucket_name(self, bucket) -> str:
+        if bucket[0] == "packed":
+            return f"t{bucket[1]}_r{bucket[2]}"
         return f"b{bucket[0]}" + (f"_s{bucket[1]}" if bucket[1] else "")
 
     def _breaker_for(self, bucket) -> CircuitBreaker:
@@ -448,10 +520,48 @@ class ServingEngine:
             padded.append(out)
         return tuple(padded)
 
-    def dispatch(self, arrays: Dict[str, np.ndarray]) -> ServeResult:
+    def _guarded_execute(self, bucket, padded: tuple):
+        """Breaker-gated executable call shared by both dispatch modes:
+        fail fast when the bucket's circuit is open, record the outcome
+        either way."""
+        breaker = self._breaker_for(bucket)
+        if not breaker.allow():
+            # fail fast with backpressure instead of queueing work
+            # behind a bucket that keeps failing (docs/RESILIENCE.md)
+            retry_after = breaker.retry_after()
+            self._m_unavailable.labels(reason="circuit_open").inc()
+            self._m_retry_after.set(retry_after)
+            raise Unavailable("circuit_open", bucket=bucket,
+                              retry_after_s=retry_after)
+        with self._exe_lock:
+            known = bucket in self._exe
+        if known:
+            self._m_hits.inc()
+        try:
+            exe = self._ensure_executable(bucket)
+            faults.maybe_raise("serve.dispatch")
+            outputs = exe(self._params, *padded)
+        except Unavailable:
+            raise
+        except Exception:
+            self._m_dispatch_fail.labels(
+                bucket=self._bucket_name(bucket)).inc()
+            breaker.record_failure()
+            raise
+        breaker.record_success()
+        self._m_dispatch.labels(bucket=self._bucket_name(bucket)).inc()
+        return outputs
+
+    def dispatch(self, arrays: Dict[str, np.ndarray],
+                 lengths: Optional[np.ndarray] = None) -> ServeResult:
         """Run one bucketed forward. ``arrays`` maps the graph's input
         names to HOST arrays (rows ≤ the largest batch bucket). Returns
         device-resident outputs; nothing in here blocks on the device.
+
+        ``lengths`` (per-request true token counts, host int array)
+        makes the waste metrics exact: without it the intra-batch
+        padding — short requests padded to the batch width upstream —
+        is invisible and waste undercounts.
         """
         expect = {spec.name for spec in self.graph.inputs}
         if set(arrays) != expect:
@@ -469,41 +579,107 @@ class ServingEngine:
                 raise ValueError(
                     f"input {spec.name!r} shape "
                     f"{tuple(arrays[spec.name].shape)} != {want}")
+        if lengths is not None and lengths.shape[0] != n:
+            raise ValueError(
+                f"lengths has {lengths.shape[0]} entries for {n} rows")
         bucket = self.bucket_for(n, length)
-        breaker = self._breaker_for(bucket)
-        if not breaker.allow():
-            # fail fast with backpressure instead of queueing work
-            # behind a bucket that keeps failing (docs/RESILIENCE.md)
-            retry_after = breaker.retry_after()
-            self._m_unavailable.labels(reason="circuit_open").inc()
-            self._m_retry_after.set(retry_after)
-            raise Unavailable("circuit_open", bucket=bucket,
-                              retry_after_s=retry_after)
-        with self._exe_lock:
-            known = bucket in self._exe
-        if known:
-            self._m_hits.inc()
-        try:
-            exe = self._ensure_executable(bucket)
-            faults.maybe_raise("serve.dispatch")
-            outputs = exe(self._params,
-                          *self._pad_to_bucket(arrays, bucket))
-        except Unavailable:
-            raise
-        except Exception:
-            bname = self._bucket_name(bucket)
-            self._m_dispatch_fail.labels(bucket=bname).inc()
-            breaker.record_failure()
-            raise
-        breaker.record_success()
+        outputs = self._guarded_execute(
+            bucket, self._pad_to_bucket(arrays, bucket))
 
-        bname = self._bucket_name(bucket)
-        self._m_dispatch.labels(bucket=bname).inc()
         self._m_occupancy.observe(n / bucket[0])
         if self.graph.seq_bucketable:
-            waste = 1.0 - (n * length) / (bucket[0] * bucket[1])
+            total = bucket[0] * bucket[1]
+            if lengths is not None:
+                real = int(lengths.sum())
+            else:
+                # batch width as a lower bound — intra-batch padding
+                # is invisible without per-request lengths
+                real = n * length
+            waste = 1.0 - real / total
+            self._m_padded_tokens.labels(mode="rect").inc(total - real)
         else:
             waste = 1.0 - n / bucket[0]
         self._m_waste.observe(waste)
         return ServeResult(outputs=outputs, batch=n, length=length,
-                           bucket=bucket)
+                           bucket=bucket, lengths=lengths)
+
+    # -- packed (ragged) dispatch -----------------------------------------
+
+    def packed_bucket_for(self, tokens: int, requests: int
+                          ) -> Tuple[object, int, int]:
+        """Smallest configured token-budget bucket fitting the batch.
+        Packed mode is AOT-only — no lazy exact-shape fallback (the
+        whole point is a closed executable set over the token axis)."""
+        if not self.packed_buckets:
+            raise ValueError("engine has no packed_buckets configured")
+        fit = next(((t, r) for t, r in self.packed_buckets
+                    if t >= tokens and r >= requests), None)
+        if fit is None:
+            t_max, r_max = self.packed_buckets[-1]
+            raise RequestTooLarge(
+                f"packed batch (tokens={tokens}, requests={requests}) "
+                f"exceeds buckets tokens≤{t_max}, rows≤{r_max}")
+        return ("packed",) + fit
+
+    def _pad_packed(self, arrays: dict, bucket) -> tuple:
+        _, tokens, rows = bucket
+        total = int(arrays["lengths"].sum())
+        padded = []
+        for spec in self.packed_graph.inputs:
+            arr = arrays[spec.name]
+            shape = spec.shape(tokens, rows)
+            if tuple(arr.shape) == shape:
+                padded.append(arr)
+                continue
+            # unused rows become empty spans parked at the end of the
+            # real tokens (offset=total, length=0): the ragged kernels
+            # do zero work for them and the tail pad ids are inert
+            fill = total if spec.name == "row_offsets" else spec.pad_value
+            out = np.full(shape, fill, dtype=np.dtype(spec.dtype))
+            out[:arr.shape[0]] = arr
+            padded.append(out)
+        return tuple(padded)
+
+    def dispatch_packed(self, arrays: Dict[str, np.ndarray]
+                        ) -> PackedServeResult:
+        """Run one packed ragged forward. ``arrays`` holds the packed
+        graph's inputs at their true sizes: ``packed_ids`` (total_tokens,)
+        int32, ``row_offsets``/``lengths`` (n_requests,) int32. Padding
+        to the token-budget bucket happens here; outputs stay on device.
+        """
+        if self.packed_graph is None or not self.packed_buckets:
+            raise ValueError(
+                "engine has no packed mode configured — pass "
+                "packed_buckets (and a task or packed_graph)")
+        expect = {spec.name for spec in self.packed_graph.inputs}
+        if set(arrays) != expect:
+            raise ValueError(
+                f"dispatch_packed inputs {sorted(arrays)} != expected "
+                f"{sorted(expect)}")
+        lengths = arrays["lengths"]
+        row_offsets = arrays["row_offsets"]
+        n = lengths.shape[0]
+        if n < 1:
+            raise ValueError("empty request batch")
+        if row_offsets.shape[0] != n:
+            raise ValueError(
+                f"row_offsets has {row_offsets.shape[0]} entries for "
+                f"{n} lengths")
+        max_len = int(lengths.max())
+        if max_len > self.packed_graph.max_seq_len:
+            raise RequestTooLarge(
+                f"request length {max_len} exceeds the model's "
+                f"max_seq_len {self.packed_graph.max_seq_len}")
+        tokens = arrays["packed_ids"].shape[0]
+        bucket = self.packed_bucket_for(tokens, n)
+        outputs = self._guarded_execute(
+            bucket, self._pad_packed(arrays, bucket))
+
+        _, t_bucket, r_bucket = bucket
+        real = int(lengths.sum())
+        self._m_occupancy.observe(n / r_bucket)
+        self._m_waste.observe(1.0 - real / t_bucket)
+        self._m_padded_tokens.labels(mode="packed").inc(t_bucket - real)
+        return PackedServeResult(outputs=outputs, batch=n,
+                                 lengths=lengths, row_offsets=row_offsets,
+                                 bucket=bucket)

@@ -36,6 +36,9 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from perceiver_tpu.ops.linear import linear_apply
+from perceiver_tpu.ops.mlp import mlp_apply
+from perceiver_tpu.ops.norm import layer_norm_apply
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
 from perceiver_tpu.tokenizer import MASK_TOKEN_ID, PAD_TOKEN_ID
 
@@ -211,3 +214,265 @@ def build_serve_graph(task, *, policy: Policy = DEFAULT_POLICY,
         f"no serve graph for task type {type(task).__name__}; supported: "
         "MaskedLanguageModelTask, TextClassifierTask, "
         "ImageClassifierTask, SegmentationTask")
+
+
+# --- packed (ragged) serve graphs --------------------------------------------
+#
+# The packed path replaces the [B, S] rectangle with one concatenated
+# token axis plus per-request (row_offsets, lengths) descriptors — the
+# layout the Pallas ragged kernels (ops/ragged_attention.py) consume.
+# Padding then exists only at the tail of the token buffer (to the
+# token-budget bucket) and in unused request rows, and both are inert:
+# the ragged cross-attention kernel skips kv blocks outside a request's
+# span, and zero-length rows produce zero latents.
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedServeGraph:
+    """A seq-bucketable task's serve computation over a packed batch.
+
+    ``fn(params, packed_ids, row_offsets, lengths)`` returns a dict of
+    device arrays: *token-axis* outputs are shaped ``(T, ...)`` along
+    the packed token buffer (slice per request with ``row_offsets`` /
+    ``lengths``); *request-axis* outputs are shaped ``(R, ...)``.
+    ``inputs`` shape callables take ``(tokens, rows)`` — the
+    token-budget bucket. ``max_seq_len`` caps any single request (the
+    model's position-table size)."""
+
+    kind: str
+    model: object
+    fn: Callable
+    inputs: Tuple[InputSpec, ...]
+    output_names: Tuple[str, ...]
+    donate_argnums: Tuple[int, ...]
+    max_seq_len: int
+    token_axis_outputs: Tuple[str, ...] = ()
+    request_axis_outputs: Tuple[str, ...] = ()
+
+    def init_params(self, seed: int = 0):
+        return self.model.init(jax.random.key(seed))
+
+
+_PACKED_INPUTS = (
+    InputSpec("packed_ids", jnp.int32, lambda t, r: (t,), PAD_TOKEN_ID),
+    # pad value is a placeholder: the engine pads unused rows with the
+    # batch's total real token count (an empty span parked at the end
+    # of the real tokens), not a constant
+    InputSpec("row_offsets", jnp.int32, lambda t, r: (r,), 0),
+    InputSpec("lengths", jnp.int32, lambda t, r: (r,), 0),
+)
+
+
+def _packed_rows_positions(row_offsets, lengths, tokens: int,
+                           max_seq_len: int):
+    """Per-token (row, in-request position) from the span descriptors.
+
+    ``searchsorted(side="right") - 1`` maps token index → owning row;
+    repeated offsets (zero-length rows) resolve to the *last* row
+    starting there, and tail padding clamps to the final row — both
+    yield garbage rows whose outputs the host never reads (it slices by
+    real spans), so only finiteness matters there."""
+    del lengths
+    n_rows = row_offsets.shape[0]
+    tok = jnp.arange(tokens, dtype=jnp.int32)
+    rows = jnp.clip(
+        jnp.searchsorted(row_offsets, tok, side="right").astype(jnp.int32) - 1,
+        0, n_rows - 1)
+    positions = jnp.clip(tok - jnp.take(row_offsets, rows), 0,
+                         max_seq_len - 1)
+    return rows, positions
+
+
+def _packed_encoder_apply(encoder, params, packed_ids, positions,
+                          row_offsets, lengths, *, policy: Policy,
+                          block_k: int = 128):
+    """Encoder forward over a packed token axis → (R, N, C) latents.
+
+    Mirrors ``PerceiverEncoder.apply`` (hoisted kv, layer_1 then a
+    ``layer_n`` scan) with the masked einsum cross-attention swapped
+    for ``ragged_cross_attention``: the kv projections run ONCE over
+    the packed buffer — total real tokens, not B×S — and each
+    request's latents attend only to the kv blocks its span covers."""
+    from perceiver_tpu.models.perceiver import self_attention_block_apply
+    from perceiver_tpu.ops.attention import cross_attention_kv
+    from perceiver_tpu.ops.ragged_attention import ragged_cross_attention
+
+    n_req = row_offsets.shape[0]
+    n_lat, channels = encoder.latent_shape
+    num_heads = encoder.num_cross_attention_heads
+    max_len = encoder.input_adapter.max_seq_len
+
+    # (T, C) → (1, T, C): the kv projections expect a batch axis
+    x_kv = encoder.input_adapter.apply_packed(
+        params["input_adapter"], packed_ids, positions, policy=policy)[None]
+    latent = jnp.broadcast_to(
+        policy.cast_param(params["latent"])[None], (n_req, n_lat, channels))
+
+    def layer_kv(layer_params):
+        kh, vh = cross_attention_kv(layer_params["cross"]["attn"], x_kv,
+                                    num_heads=num_heads, policy=policy)
+        # (1, T, H, Dh) → (H, T, Dh)
+        return kh[0].swapaxes(0, 1), vh[0].swapaxes(0, 1)
+
+    def one_layer(layer_params, kv, lat):
+        attn = layer_params["cross"]["attn"]
+        kh, vh = kv
+        xq = layer_norm_apply(attn["norm_q"], lat, policy=policy)
+        qh = linear_apply(attn["mha"]["q"], xq, policy=policy)
+        head_dim = qh.shape[-1] // num_heads
+        q = qh.reshape(n_req, n_lat, num_heads, head_dim).transpose(
+            0, 2, 1, 3)
+        o = ragged_cross_attention(
+            q, kh, vh, row_offsets, lengths,
+            scale=1.0 / (head_dim ** 0.5), block_k=block_k,
+            max_len=max_len)
+        o = o.transpose(0, 2, 1, 3).reshape(n_req, n_lat,
+                                            num_heads * head_dim)
+        o = linear_apply(attn["mha"]["out"], o, policy=policy)
+        y = lat + o
+        y = y + mlp_apply(layer_params["cross"]["mlp"], y, policy=policy)
+        return self_attention_block_apply(
+            layer_params["selfs"], y,
+            num_heads=encoder.num_self_attention_heads, policy=policy)
+
+    latent = one_layer(params["layer_1"], layer_kv(params["layer_1"]),
+                       latent)
+    if encoder.num_layers > 1:
+        layer_n = params["layer_n"]
+        kv_n = layer_kv(layer_n)
+
+        def body(carry, _):
+            return one_layer(layer_n, kv_n,
+                             policy.cast_compute(carry)), None
+
+        latent, _ = jax.lax.scan(body, latent, None,
+                                 length=encoder.num_layers - 1)
+    return latent
+
+
+def _packed_mlm_decode(decoder, params, latent, positions, rows, *,
+                       policy: Policy):
+    """Per-token MLM decode: each packed token queries ITS request's
+    latents via the block-diagonal ragged decode kernel, so the decoder
+    runs over total real tokens instead of B×S query rows."""
+    from perceiver_tpu.ops.ragged_attention import ragged_decode_attention
+
+    n_req, n_lat, _ = latent.shape
+    num_heads = decoder.num_cross_attention_heads
+    tokens = positions.shape[0]
+    attn = params["cross"]["attn"]
+
+    query = jnp.take(policy.cast_param(params["query"]), positions, axis=0)
+    xq = layer_norm_apply(attn["norm_q"], query, policy=policy)
+    qh = linear_apply(attn["mha"]["q"], xq, policy=policy)
+    head_dim = qh.shape[-1] // num_heads
+    q = qh.reshape(tokens, num_heads, head_dim).swapaxes(0, 1)  # (H, T, Dh)
+
+    xkv = layer_norm_apply(attn["norm_kv"], latent, policy=policy)
+    kh = linear_apply(attn["mha"]["k"], xkv, policy=policy)
+    vh = linear_apply(attn["mha"]["v"], xkv, policy=policy)
+    kh = kh.reshape(n_req * n_lat, num_heads, head_dim).swapaxes(0, 1)
+    vh = vh.reshape(n_req * n_lat, num_heads, head_dim).swapaxes(0, 1)
+
+    o = ragged_decode_attention(q, kh, vh, rows, latents_per_row=n_lat,
+                                scale=1.0 / (head_dim ** 0.5))
+    o = o.swapaxes(0, 1).reshape(tokens, num_heads * head_dim)
+    o = linear_apply(attn["mha"]["out"], o, policy=policy)
+    x = query + o
+    hidden = x + mlp_apply(params["cross"]["mlp"], x, policy=policy)
+    return linear_apply(params["output_adapter"]["linear"], hidden,
+                        policy=policy)  # (T, V)
+
+
+def packed_mlm_serve_graph(model, *, policy: Policy = DEFAULT_POLICY,
+                           top_k: int = 3,
+                           max_seq_len: Optional[int] = None,
+                           block_k: int = 128) -> PackedServeGraph:
+    if max_seq_len is None:
+        max_seq_len = model.decoder.output_adapter.output_shape[0]
+
+    def fn(params, packed_ids, row_offsets, lengths):
+        tokens = packed_ids.shape[0]
+        rows, positions = _packed_rows_positions(
+            row_offsets, lengths, tokens, max_seq_len)
+        latent = _packed_encoder_apply(
+            model.encoder, params["encoder"], packed_ids, positions,
+            row_offsets, lengths, policy=policy, block_k=block_k)
+        logits = _packed_mlm_decode(model.decoder, params["decoder"],
+                                    latent, positions, rows, policy=policy)
+        scores, topk_ids = jax.lax.top_k(
+            logits.astype(jnp.float32), top_k)
+        topk_ids = topk_ids.astype(packed_ids.dtype)
+        is_masked = packed_ids == MASK_TOKEN_ID
+        # lax.select, not jnp.where: jnp.where is a jitted wrapper
+        # whose module-level _where func dedups against the identical
+        # inner func of the jitted takes — a dedup that depends on
+        # jit-cache retention across lowerings, so module text (and the
+        # exec-cache key) would drift with process history
+        filled_ids = jax.lax.select(is_masked, topk_ids[..., 0],
+                                    packed_ids)
+        return {"filled_ids": filled_ids, "topk_ids": topk_ids,
+                "topk_scores": scores, "is_masked": is_masked}
+
+    return PackedServeGraph(
+        kind="mlm_packed", model=model, fn=fn, inputs=_PACKED_INPUTS,
+        output_names=("filled_ids", "topk_ids", "topk_scores",
+                      "is_masked"),
+        token_axis_outputs=("filled_ids", "topk_ids", "topk_scores",
+                            "is_masked"),
+        # packed_ids (T,) int32 aliases filled_ids exactly; the span
+        # descriptors are tiny and re-read by the host, so they stay
+        donate_argnums=(1,),
+        max_seq_len=max_seq_len)
+
+
+def packed_text_clf_serve_graph(task, *,
+                                policy: Policy = DEFAULT_POLICY,
+                                block_k: int = 128) -> PackedServeGraph:
+    model = task.build()
+    max_seq_len = task.max_seq_len
+
+    def fn(params, packed_ids, row_offsets, lengths):
+        tokens = packed_ids.shape[0]
+        _, positions = _packed_rows_positions(
+            row_offsets, lengths, tokens, max_seq_len)
+        latent = _packed_encoder_apply(
+            model.encoder, params["encoder"], packed_ids, positions,
+            row_offsets, lengths, policy=policy, block_k=block_k)
+        # per-request latents are an ordinary (R, N, C) batch — the
+        # rectangular decoder applies unchanged (latent kv, no padding)
+        logits = model.decoder.apply(params["decoder"], latent,
+                                     policy=policy)
+        logits = logits.astype(jnp.float32)
+        return {"logits": logits,
+                "probs": jax.nn.softmax(logits, axis=-1),
+                "label": jnp.argmax(logits, axis=-1).astype(jnp.int32)}
+
+    return PackedServeGraph(
+        kind="text_clf_packed", model=model, fn=fn, inputs=_PACKED_INPUTS,
+        output_names=("logits", "probs", "label"),
+        request_axis_outputs=("logits", "probs", "label"),
+        donate_argnums=(),
+        max_seq_len=max_seq_len)
+
+
+def build_packed_serve_graph(task, *, policy: Policy = DEFAULT_POLICY,
+                             top_k: int = 3) -> PackedServeGraph:
+    """Packed serve graph for a seq-bucketable task config. Fixed-shape
+    (image) tasks have nothing to pack — rectangles remain their only
+    path."""
+    from perceiver_tpu.tasks import (
+        MaskedLanguageModelTask,
+        TextClassifierTask,
+    )
+
+    if isinstance(task, MaskedLanguageModelTask):
+        return packed_mlm_serve_graph(task.build(), policy=policy,
+                                      top_k=top_k,
+                                      max_seq_len=task.max_seq_len)
+    if isinstance(task, TextClassifierTask):
+        return packed_text_clf_serve_graph(task, policy=policy)
+    raise TypeError(
+        f"no packed serve graph for task type {type(task).__name__}; "
+        "supported: MaskedLanguageModelTask, TextClassifierTask "
+        "(fixed-shape tasks serve rectangles)")

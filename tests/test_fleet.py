@@ -523,6 +523,68 @@ def test_rpc_client_server_call_and_typed_errors():
         server.close()
 
 
+# --- packed-mode replica (real engine, loopback RPC) -------------------------
+
+
+def test_router_routes_packed_payloads_over_real_replica():
+    """ISSUE 9 satellite: fleet routing over a packed-mode replica.
+    A REAL in-process ``ReplicaServer`` built with ``packed_buckets``
+    serves ragged payloads through the router's normal dispatch path —
+    the RPC envelope and router are payload-agnostic, so the packed
+    arrays ride the same ``dispatch`` op, and the same replica still
+    accepts rectangular payloads."""
+    from perceiver_tpu.fleet.replica import ReplicaServer
+    from perceiver_tpu.fleet.supervisor import RpcReplicaHandle
+
+    spec = {
+        "task_class": "MaskedLanguageModelTask",
+        "task_kwargs": dict(
+            vocab_size=110, max_seq_len=32, num_latents=4,
+            num_latent_channels=8, num_encoder_layers=1,
+            num_encoder_self_attention_layers_per_block=1,
+            num_encoder_cross_attention_heads=1,
+            num_encoder_self_attention_heads=1,
+            num_decoder_cross_attention_heads=1, loss_impl="dense"),
+        "batch_buckets": [1],
+        "seq_buckets": [16],
+        "packed_buckets": [[32, 2]],
+    }
+    replica = ReplicaServer(spec)
+    handle = RpcReplicaHandle("127.0.0.1", replica.server.port,
+                              dispatch_timeout_s=60.0)
+    router, _ = make_router()
+    try:
+        router.add("r0", handle)
+        lens = np.asarray([9, 16], np.int32)
+        offs = np.asarray([0, 9], np.int32)
+        rng = np.random.default_rng(0)
+        packed = rng.integers(3, 110, (25,)).astype(np.int32)
+        reply = router.submit({"packed_ids": packed,
+                               "row_offsets": offs, "lengths": lens})
+        out = reply["outputs"]
+        assert out["filled_ids"].shape == (25,)
+        assert out["topk_ids"].shape[0] == 25
+        assert reply["health"] == "READY"
+        # the same replica still serves rectangular payloads
+        rect = router.submit({
+            "input_ids": rng.integers(3, 110, (1, 16)).astype(np.int32),
+            "pad_mask": np.zeros((1, 16), bool)})
+        assert rect["outputs"]["filled_ids"].shape == (1, 16)
+        assert router.metrics.get("fleet_requests_total").value_of(
+            outcome="ok") == 2.0
+        # a packed batch beyond the replica's buckets fails typed and
+        # deterministic — the router must NOT retry it on a sibling
+        with pytest.raises(RequestTooLarge):
+            router.submit({
+                "packed_ids": rng.integers(3, 110, (40,)).astype(
+                    np.int32),
+                "row_offsets": np.asarray([0, 20], np.int32),
+                "lengths": np.asarray([20, 20], np.int32)})
+    finally:
+        handle.close()
+        replica.close()
+
+
 def test_rpc_client_connect_refused_is_rpc_error():
     import socket
 

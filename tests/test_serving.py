@@ -25,13 +25,16 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from perceiver_tpu.ops.policy import Policy
 from perceiver_tpu.serving import (
     MicroBatcher,
     MLMServer,
     Overloaded,
     RequestTooLarge,
     ServingEngine,
+    TokenBudgetBatcher,
     materialize,
+    materialize_packed,
 )
 from perceiver_tpu.serving.metrics import MetricsRegistry
 from perceiver_tpu.tasks import MaskedLanguageModelTask
@@ -616,6 +619,413 @@ class TestPredictCompat:
         assert second["predict_compile_events"] == 0, \
             "warm-process predict must not compile"
         assert second["preds"] == first["preds"]
+
+
+def ragged_requests(lengths, seed=0, mask_every=4):
+    """Per-request id rows + the packed/rect encodings of the batch."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for n in lengths:
+        ids = rng.integers(3, VOCAB, (int(n),)).astype(np.int32)
+        ids[::mask_every] = MASK_TOKEN_ID
+        rows.append(ids)
+    lens = np.asarray(lengths, np.int32)
+    offs = np.zeros_like(lens)
+    offs[1:] = np.cumsum(lens)[:-1]
+    packed = {"packed_ids": np.concatenate(rows),
+              "row_offsets": offs, "lengths": lens}
+    return rows, packed
+
+
+class TestPackedEngine:
+    """Packed (ragged) dispatch: parity with the rectangular path per
+    request, exact waste accounting, AOT-only bucketing (ISSUE 9)."""
+
+    @pytest.fixture(scope="class")
+    def packed_engine(self):
+        # fp32 so packed-vs-rect comparisons are numerical, not
+        # bf16-rounding roulette; registered canonical targets cover
+        # the bf16 serve policy
+        return ServingEngine(tiny_mlm_task(), batch_buckets=(1, 4),
+                             seq_buckets=(16, 32),
+                             packed_buckets=((64, 4), (128, 8)),
+                             policy=Policy.fp32())
+
+    def test_warmup_includes_packed_buckets(self, packed_engine):
+        assert packed_engine.compiled_buckets == (
+            (1, 16), (1, 32), (4, 16), (4, 32),
+            ("packed", 64, 4), ("packed", 128, 8))
+
+    def _rect_single(self, engine, ids_row):
+        n = len(ids_row)
+        arrays = {"input_ids": ids_row[None, :],
+                  "pad_mask": np.zeros((1, n), bool)}
+        return materialize(engine.dispatch(arrays), engine.graph)
+
+    @pytest.mark.parametrize("lengths", [
+        [9, 30, 3, 16],      # mixed, mid-bucket occupancy
+        [13],                # single request
+        [16, 16, 16, 16],    # exactly fills the (64, 4) bucket
+    ])
+    def test_parity_with_rect_per_request(self, packed_engine, lengths):
+        rows, packed = ragged_requests(lengths, seed=17)
+        res = packed_engine.dispatch_packed(packed)
+        out = materialize_packed(res, packed_engine.packed_graph)
+        off = 0
+        for ids_row in rows:
+            n = len(ids_row)
+            want = self._rect_single(packed_engine, ids_row)
+            got_filled = out["filled_ids"][off:off + n]
+            np.testing.assert_array_equal(got_filled,
+                                          want["filled_ids"][0])
+            np.testing.assert_array_equal(out["is_masked"][off:off + n],
+                                          want["is_masked"][0])
+            np.testing.assert_array_equal(out["topk_ids"][off:off + n],
+                                          want["topk_ids"][0])
+            np.testing.assert_allclose(out["topk_scores"][off:off + n],
+                                       want["topk_scores"][0],
+                                       atol=1e-4, rtol=1e-4)
+            off += n
+
+    def test_zero_new_compiles_across_packed_shapes(self, packed_engine):
+        shapes = [[5], [9, 30, 3], [16, 16, 16, 16], [32, 32, 31],
+                  [1, 1, 1, 1, 1]]
+        with compile_events() as events:
+            for i, lengths in enumerate(shapes):
+                _, packed = ragged_requests(lengths, seed=i)
+                res = packed_engine.dispatch_packed(packed)
+                materialize_packed(res, packed_engine.packed_graph)
+        assert events == [], f"packed dispatch compiled: {events}"
+
+    def test_smallest_fitting_token_bucket(self, packed_engine):
+        assert packed_engine.packed_bucket_for(10, 2) == ("packed", 64, 4)
+        assert packed_engine.packed_bucket_for(64, 4) == ("packed", 64, 4)
+        assert packed_engine.packed_bucket_for(65, 2) == ("packed", 128, 8)
+        assert packed_engine.packed_bucket_for(10, 5) == ("packed", 128, 8)
+        with pytest.raises(RequestTooLarge):
+            packed_engine.packed_bucket_for(129, 1)
+        with pytest.raises(RequestTooLarge):
+            packed_engine.packed_bucket_for(8, 9)
+
+    def test_request_longer_than_model_rejected(self, packed_engine):
+        # 40 tokens fits the 64-token budget but exceeds max_seq_len=32
+        _, packed = ragged_requests([40], seed=3)
+        with pytest.raises(RequestTooLarge, match="max_seq_len"):
+            packed_engine.dispatch_packed(packed)
+
+    def test_input_validation(self, packed_engine):
+        _, packed = ragged_requests([5, 6], seed=4)
+        with pytest.raises(ValueError, match="inputs"):
+            packed_engine.dispatch_packed(
+                {"packed_ids": packed["packed_ids"]})
+        bad = dict(packed)
+        bad["row_offsets"] = bad["row_offsets"][:1]
+        with pytest.raises(ValueError, match="row_offsets"):
+            packed_engine.dispatch_packed(bad)
+
+    def test_engine_without_packed_mode_rejects(self):
+        eng = ServingEngine(tiny_mlm_task(), batch_buckets=(1,),
+                            seq_buckets=(16,))
+        _, packed = ragged_requests([5], seed=5)
+        with pytest.raises(ValueError, match="packed"):
+            eng.dispatch_packed(packed)
+
+    def test_padded_token_accounting_exact(self):
+        """Satellite 1: the waste metrics count TRUE padded tokens.
+        Rect dispatch with per-request lengths no longer undercounts
+        intra-batch padding; packed dispatch counts only its bucket
+        tail."""
+        metrics = MetricsRegistry()
+        eng = ServingEngine(tiny_mlm_task(), batch_buckets=(1, 4),
+                            seq_buckets=(16, 32),
+                            packed_buckets=((64, 4),), metrics=metrics)
+        counter = metrics.get("serving_padded_tokens_total")
+        waste = metrics.get("serving_padding_waste_fraction")
+
+        rows, packed = ragged_requests([9, 30, 3], seed=6)
+        # rect: requests padded to width 30 upstream, bucket (4, 32)
+        ids = np.zeros((3, 30), np.int32)
+        for i, r in enumerate(rows):
+            ids[i, :len(r)] = r
+        pad = np.arange(30)[None, :] >= packed["lengths"][:, None]
+        res = eng.dispatch({"input_ids": ids, "pad_mask": pad},
+                           lengths=packed["lengths"])
+        assert res.lengths is packed["lengths"]
+        assert counter.value_of(mode="rect") == 4 * 32 - 42
+        assert waste.sum == pytest.approx(1 - 42 / 128)
+
+        # packed: same requests, 64-token bucket, 22-token tail
+        eng.dispatch_packed(packed)
+        assert counter.value_of(mode="packed") == 64 - 42
+        assert waste.sum == pytest.approx((1 - 42 / 128) + (1 - 42 / 64))
+
+    def test_rect_without_lengths_keeps_lower_bound(self):
+        metrics = MetricsRegistry()
+        eng = ServingEngine(tiny_mlm_task(), batch_buckets=(1,),
+                            seq_buckets=(16,), metrics=metrics)
+        eng.dispatch(request_arrays(1, 9))
+        # no lengths: only the bucket-width padding is visible
+        assert metrics.get("serving_padded_tokens_total").value_of(
+            mode="rect") == 16 - 9
+
+    def test_packed_bucket_dispatch_labels(self, packed_engine):
+        # packed buckets get their own t{tokens}_r{rows} label family,
+        # disjoint from the rect b{batch}_s{seq} names
+        dispatch = packed_engine.metrics.get(
+            "serving_bucket_dispatch_total")
+        assert dispatch.value_of(bucket="t64_r4") > 0
+        assert dispatch.value_of(bucket="b1_s16") > 0
+
+
+class TestPackedTextClassifier:
+    def _tiny_clf_task(self):
+        from perceiver_tpu.tasks import TextClassifierTask
+        return TextClassifierTask(
+            num_classes=2, vocab_size=VOCAB, max_seq_len=32,
+            num_latents=4, num_latent_channels=8, num_encoder_layers=1,
+            num_encoder_self_attention_layers_per_block=1,
+            num_encoder_cross_attention_heads=1,
+            num_encoder_self_attention_heads=1,
+            num_decoder_cross_attention_heads=1)
+
+    def test_parity_with_rect_per_request(self):
+        eng = ServingEngine(self._tiny_clf_task(), batch_buckets=(1, 4),
+                            seq_buckets=(16, 32),
+                            packed_buckets=((64, 4),),
+                            policy=Policy.fp32())
+        rows, packed = ragged_requests([9, 30, 3], seed=21)
+        res = eng.dispatch_packed(packed)
+        out = materialize_packed(res, eng.packed_graph)
+        assert out["logits"].shape == (3, 2)
+        for i, ids_row in enumerate(rows):
+            n = len(ids_row)
+            arrays = {"input_ids": ids_row[None, :],
+                      "pad_mask": np.zeros((1, n), bool)}
+            want = materialize(eng.dispatch(arrays), eng.graph)
+            np.testing.assert_allclose(out["logits"][i],
+                                       want["logits"][0],
+                                       atol=1e-4, rtol=1e-4)
+            assert out["label"][i] == want["label"][0]
+
+
+class TestTokenBudgetBatcher:
+    """Continuous batching by token budget (satellite 3): grouping by
+    cost, and the MicroBatcher contract — deadline shed, drain,
+    close — intact through the subclass."""
+
+    def test_groups_by_token_budget(self):
+        seen = []
+        hold = threading.Event()
+
+        def runner(items):
+            seen.append(list(items))
+            hold.wait(0.2)
+            return [x * 10 for x in items]
+
+        tb = TokenBudgetBatcher(runner, token_budget=10,
+                                cost_fn=lambda x: x, max_delay_ms=50,
+                                max_depth=64)
+        try:
+            costs = [4, 4, 4, 11, 2, 9]
+            futs = [tb.submit(c) for c in costs]
+            hold.set()
+            assert [f.result(timeout=10) for f in futs] == [
+                c * 10 for c in costs]
+            for batch in seen:
+                # over-budget batches only as a head-of-line singleton
+                assert sum(batch) <= 10 or len(batch) == 1
+            # the 11-cost request went alone even though budget is 10
+            assert [11] in seen
+        finally:
+            tb.close()
+
+    def test_max_requests_caps_rows(self):
+        hold = threading.Event()
+        seen = []
+
+        def runner(items):
+            seen.append(list(items))
+            hold.wait(0.2)
+            return items
+
+        tb = TokenBudgetBatcher(runner, token_budget=10_000,
+                                cost_fn=lambda x: 1, max_requests=3,
+                                max_delay_ms=50)
+        try:
+            futs = [tb.submit(i) for i in range(10)]
+            hold.set()
+            [f.result(timeout=10) for f in futs]
+            assert max(len(b) for b in seen) <= 3
+        finally:
+            tb.close()
+
+    def test_invalid_budget_rejected(self):
+        with pytest.raises(ValueError, match="token_budget"):
+            TokenBudgetBatcher(lambda x: x, token_budget=0,
+                               cost_fn=lambda x: 1)
+
+    def test_deadline_shed_before_compute(self):
+        ran = []
+        release = threading.Event()
+
+        def runner(items):
+            release.wait(5)
+            ran.extend(items)
+            return items
+
+        tb = TokenBudgetBatcher(runner, token_budget=8,
+                                cost_fn=lambda x: 4, max_delay_ms=0,
+                                max_depth=16)
+        try:
+            blocker = tb.submit("blocker")
+            time.sleep(0.05)
+            doomed = tb.submit("doomed", timeout_ms=1)
+            time.sleep(0.05)
+            release.set()
+            assert blocker.result(timeout=10) == "blocker"
+            r = doomed.result(timeout=10)
+            assert isinstance(r, Overloaded) and r.reason == "deadline"
+            assert "doomed" not in ran
+        finally:
+            tb.close()
+
+    def test_queue_full_sheds_typed(self):
+        release = threading.Event()
+
+        def runner(items):
+            release.wait(5)
+            return items
+
+        tb = TokenBudgetBatcher(runner, token_budget=4,
+                                cost_fn=lambda x: 4, max_delay_ms=0,
+                                max_depth=2)
+        try:
+            futs = [tb.submit(i) for i in range(12)]
+            release.set()
+            results = [f.result(timeout=10) for f in futs]
+            shed = [r for r in results if isinstance(r, Overloaded)]
+            assert shed
+            assert all(s.reason == "queue_full" for s in shed)
+        finally:
+            tb.close()
+
+    def test_drain_and_close_contract(self):
+        release = threading.Event()
+
+        def runner(items):
+            release.wait(5)
+            return items
+
+        tb = TokenBudgetBatcher(runner, token_budget=6,
+                                cost_fn=lambda x: 3, max_delay_ms=0,
+                                max_depth=16)
+        futs = [tb.submit(i) for i in range(5)]
+        assert not tb.drain(timeout=0.1)
+        release.set()
+        assert tb.drain(timeout=10)
+        assert tb.depth == 0 and tb.inflight == 0
+        assert [f.result(timeout=1) for f in futs] == list(range(5))
+        tb.close()
+        tb.close()  # idempotent
+
+    def test_close_strands_typed_when_wedged(self):
+        from perceiver_tpu.serving.errors import Unavailable
+
+        wedge = threading.Event()
+
+        def runner(items):
+            wedge.wait(30)
+            return items
+
+        tb = TokenBudgetBatcher(runner, token_budget=4,
+                                cost_fn=lambda x: 4, max_delay_ms=0,
+                                max_depth=16)
+        futs = [tb.submit(i) for i in range(4)]
+        time.sleep(0.05)
+        tb.close(timeout=0.2)
+        stranded = 0
+        for f in futs:
+            if f.done() and f.exception() is not None:
+                assert isinstance(f.exception(), Unavailable)
+                assert f.exception().reason == "shutting_down"
+                stranded += 1
+        assert stranded >= 1
+        wedge.set()
+
+
+class TestPackedMLMServer:
+    """The packed server path end to end: tokenizing at submit,
+    token-budget batching, ragged dispatch, per-request slicing."""
+
+    @pytest.fixture(scope="class")
+    def engines(self):
+        policy = Policy.fp32()
+        rect = ServingEngine(tiny_mlm_task(), batch_buckets=(1, 4),
+                             seq_buckets=(16, 32), policy=policy)
+        packed = ServingEngine(tiny_mlm_task(), batch_buckets=(),
+                               seq_buckets=(),
+                               allow_unlisted_buckets=True,
+                               packed_buckets=((64, 4), (128, 8)),
+                               policy=policy)
+        return rect, packed
+
+    def test_packed_matches_rect_server_predictions(self, engines):
+        rect_eng, packed_eng = engines
+        tok = make_tiny_tokenizer()
+        texts = ["the quick [MASK] jumps",
+                 "a [MASK] movie about a [MASK] dog",
+                 ("the quick brown fox jumps over the lazy dog and "
+                  "the lazy dog sleeps near the [MASK] fox"),
+                 "the [MASK] dog"]
+        rect_srv = MLMServer(rect_eng, tok, max_delay_ms=10)
+        packed_srv = MLMServer(packed_eng, tok, packed=True,
+                               max_delay_ms=10)
+        try:
+            with compile_events() as events:
+                rf = [rect_srv.submit(t) for t in texts]
+                pf = [packed_srv.submit(t) for t in texts]
+                rect_out = [f.result(timeout=30) for f in rf]
+                packed_out = [f.result(timeout=30) for f in pf]
+            assert events == [], "packed serving traffic compiled"
+            for t, r, p in zip(texts, rect_out, packed_out):
+                assert not isinstance(p, Overloaded)
+                assert p.text == t
+                assert p.predictions == r.predictions
+                assert p.masked_positions == r.masked_positions
+                assert p.topk_tokens == r.topk_tokens
+        finally:
+            rect_srv.close()
+            packed_srv.close()
+
+    def test_packed_requires_packed_engine(self, engines):
+        rect_eng, _ = engines
+        with pytest.raises(ValueError, match="packed_buckets"):
+            MLMServer(rect_eng, make_tiny_tokenizer(), packed=True)
+
+    def test_deadline_shed_in_packed_mode(self, engines):
+        _, packed_eng = engines
+        srv = MLMServer(packed_eng, make_tiny_tokenizer(), packed=True,
+                        max_delay_ms=10)
+        try:
+            futs = [srv.submit("the [MASK] dog", timeout_ms=0.01)
+                    for _ in range(8)]
+            results = [f.result(timeout=30) for f in futs]
+            shed = [r for r in results if isinstance(r, Overloaded)]
+            assert shed
+            assert all(s.reason == "deadline" for s in shed)
+        finally:
+            srv.close()
+
+    def test_close_resolves_every_future_packed(self, engines):
+        _, packed_eng = engines
+        srv = MLMServer(packed_eng, make_tiny_tokenizer(), packed=True,
+                        max_delay_ms=10)
+        futs = [srv.submit("the [MASK] dog") for _ in range(4)]
+        srv.close()
+        for f in futs:
+            r = f.result(timeout=1)
+            assert isinstance(r, Overloaded) or r.predictions
+        srv.close()  # idempotent
 
 
 _PREDICT_CHILD = """
