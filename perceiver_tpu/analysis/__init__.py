@@ -15,6 +15,7 @@ every pass honest against seeded violations. See docs/ANALYSIS.md.
 
 from perceiver_tpu.analysis.report import (  # noqa: F401
     DtypeAllow,
+    ReplicationAllow,
     Report,
     TransferAllow,
     Violation,
@@ -30,16 +31,28 @@ from perceiver_tpu.analysis.passes import (  # noqa: F401
     transfer_guard,
     write_hbm_budgets,
 )
+from perceiver_tpu.analysis.shardcheck import (  # noqa: F401
+    collective_budget,
+    collective_inventory,
+    load_shard_budgets,
+    per_shard_hbm_budget,
+    replication_check,
+    run_shard_passes,
+    write_shard_budgets,
+)
 from perceiver_tpu.analysis.targets import (  # noqa: F401
     CANONICAL_TARGETS,
     FAST_TARGETS,
+    MeshSpec,
     PACKED_SERVING_TARGETS,
     SERVING_TARGETS,
+    SHARDED_TARGETS,
     StepTarget,
     cost_bytes_accessed,
     lower_target,
     make_packed_serve_step,
     make_serve_step,
+    make_sharded_serve_step,
     make_train_step,
 )
 from perceiver_tpu.analysis.lint import (  # noqa: F401

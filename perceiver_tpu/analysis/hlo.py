@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import hashlib
 import re
-from typing import Dict, Iterator, List, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 # stablehlo.dot_general with optional batching_dims, capturing the
 # contracting dims and the full (operands) -> result type signature
@@ -33,8 +33,18 @@ _CONV = re.compile(
 
 _CUSTOM_CALL = re.compile(r"stablehlo\.custom_call @([A-Za-z0-9_.]+)")
 
+# arg attributes may contain quoted strings with nested braces (the
+# mhlo.sharding attr of pjit-lowered modules prints as
+# ``mhlo.sharding = "{devices=[2,2]<=[4]}"``), so the attr body match
+# must treat quoted spans as opaque instead of stopping at the first
+# ``}`` — a plain ``[^}]*`` silently drops ``tf.aliasing_output`` on
+# every sharded module
+_ATTRS = r"((?:[^{}\"]|\"[^\"]*\")*)"
 _ARG = re.compile(r"%arg\d+: tensor<([^>]+)>(?: loc\([^)]*\))?"
-                  r"(?: \{([^}]*)\})?")
+                  r"(?: \{" + _ATTRS + r"\})?")
+_RESULT = re.compile(r"tensor<([^>]+)>(?: \{" + _ATTRS + r"\})?")
+_SHARDING_ATTR = re.compile(r'mhlo\.sharding = "([^"]*)"')
+_SHARDING_DEVICES = re.compile(r"devices=\[([0-9,]+)\]")
 
 # Ops that move data across the host↔device boundary, or host-compute
 # offload markers. Python host callbacks (jax.debug.print, io_callback,
@@ -53,6 +63,28 @@ def parse_tensor(t: str) -> Tuple[List[int], str]:
     """``"512x64xbf16"`` → ``([512, 64], "bf16")``; scalars have []."""
     *dims, dtype = t.split("x")
     return [int(d) for d in dims], dtype
+
+
+# byte widths of the element types the walkers price; anything exotic
+# (future fp8 variants etc.) falls back to 4 so a new dtype can only
+# OVER-count — budgets fail loudly instead of silently under-counting
+_DTYPE_BYTES = {
+    "pred": 1, "i1": 1, "s8": 1, "u8": 1, "i8": 1, "ui8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "i32": 4, "ui32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "i64": 8, "ui64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+
+def tensor_bytes(t: str) -> int:
+    """Byte size of a tensor type string (``"512x64xbf16"`` → 65536)."""
+    dims, dtype = parse_tensor(t)
+    n = 1
+    for d in dims:
+        n *= d
+    return n * _DTYPE_BYTES.get(dtype, 4)
 
 
 def iter_dots(text: str) -> Iterator[dict]:
@@ -132,12 +164,226 @@ def main_args(text: str) -> List[dict]:
     args = []
     for m in _ARG.finditer(sig):
         attrs = m.group(2) or ""
+        sharding = _SHARDING_ATTR.search(attrs)
         args.append({
             "type": m.group(1),
             "aliased": "tf.aliasing_output" in attrs,
             "donor_only": "jax.buffer_donor" in attrs,
+            "sharding": sharding.group(1) if sharding else None,
         })
     return args
+
+
+def main_results(text: str) -> List[dict]:
+    """Per-result records from the @main signature: tensor type and the
+    ``mhlo.sharding`` annotation pjit-lowered modules carry (None on
+    unsharded modules)."""
+    sig = main_signature(text)
+    _, _, results = sig.partition(" -> ")
+    out = []
+    for m in _RESULT.finditer(results):
+        attrs = m.group(2) or ""
+        sharding = _SHARDING_ATTR.search(attrs)
+        out.append({
+            "type": m.group(1),
+            "sharding": sharding.group(1) if sharding else None,
+        })
+    return out
+
+
+def sharding_factor(sharding: Optional[str]) -> int:
+    """Number of distinct shards a GSPMD sharding annotation splits a
+    tensor into: 1 means fully replicated (every device holds the whole
+    tensor). ``{replicated}``/absent → 1; ``{devices=[2,2]<=[4]}`` → 4;
+    a trailing ``last_tile_dim_replicate`` dim only replicates, so it
+    is excluded from the product."""
+    if not sharding or "replicated}" in sharding.replace(" ", "") \
+            and "devices=" not in sharding:
+        return 1
+    m = _SHARDING_DEVICES.search(sharding)
+    if not m:
+        return 1
+    dims = [int(d) for d in m.group(1).split(",")]
+    if "last_tile_dim_replicate" in sharding and len(dims) > 1:
+        dims = dims[:-1]
+    factor = 1
+    for d in dims:
+        factor *= d
+    return factor
+
+
+# ---------------------------------------------------------------------------
+# Compiled-HLO collective walker.
+#
+# GSPMD inserts collectives during SPMD partitioning, which runs at
+# COMPILE time — the pre-optimization StableHLO of a pjit program has
+# sharding annotations but zero collective ops. The collective passes
+# therefore parse ``lowered.compile().as_text()`` (optimized HLO text),
+# which prints one op per line in the classic HLO syntax:
+#
+#   %all-reduce.1 = f32[256,256]{1,0} all-reduce(%x), channel_id=1,
+#       replica_groups={{0,2},{1,3}}, use_global_device_ids=true, ...
+#
+# Replica groups come in two formats: explicit ``{{0,2},{1,3}}`` and
+# iota ``[G,S]<=[dims]`` (optionally with a ``T(perm)`` transpose),
+# meaning iota(prod(dims)) reshaped to ``dims``, transposed by
+# ``perm``, flattened, and reshaped to G groups of S. collective-permute
+# has ``source_target_pairs`` instead; its groups are the connected
+# components of that edge list.
+
+_HLO_COLLECTIVE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?P<ty>\([^)]*\)|\S+)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute"
+    r"|all-to-all)(?:-start)?\((?P<rest>.*)$",
+    re.MULTILINE)
+_HLO_SHAPE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_REPLICA_EXPLICIT = re.compile(r"replica_groups=\{(\{[0-9,{}]*\})\}")
+_REPLICA_IOTA = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
+_SOURCE_TARGET = re.compile(r"source_target_pairs=\{([0-9,{}]*)\}")
+_GROUP_BODY = re.compile(r"\{([0-9,]*)\}")
+
+
+def _hlo_shape_bytes(ty: str) -> int:
+    """Total bytes of an optimized-HLO result type; tuple types (async
+    collectives, multi-operand all-to-all) sum their elements."""
+    total = 0
+    for m in _HLO_SHAPE.finditer(ty):
+        n = _DTYPE_BYTES.get(m.group(1), 4)
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n
+    return total
+
+
+def _iota_groups(g: int, s: int, dims: List[int],
+                 perm: Optional[List[int]]) -> List[Tuple[int, ...]]:
+    n = 1
+    for d in dims:
+        n *= d
+    flat = list(range(n))
+    if perm:
+        # reshape to dims, transpose by perm, flatten
+        strides = [1] * len(dims)
+        for i in range(len(dims) - 2, -1, -1):
+            strides[i] = strides[i + 1] * dims[i + 1]
+        out = []
+        idx = [0] * len(dims)
+        pdims = [dims[p] for p in perm]
+        def rec(depth, base_idx):
+            if depth == len(pdims):
+                off = sum(base_idx[perm[i]] * strides[perm[i]]
+                          for i in range(len(perm)))
+                out.append(flat[off])
+                return
+            for v in range(pdims[depth]):
+                base_idx[perm[depth]] = v
+                rec(depth + 1, base_idx)
+        rec(0, idx)
+        flat = out
+    return [tuple(sorted(flat[i * s:(i + 1) * s])) for i in range(g)]
+
+
+def _permute_groups(pairs_body: str) -> List[Tuple[int, ...]]:
+    """Connected components of a collective-permute edge list."""
+    parent: Dict[int, int] = {}
+    def find(x: int) -> int:
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+    for m in _GROUP_BODY.finditer(pairs_body):
+        ids = [int(v) for v in m.group(1).split(",") if v]
+        if len(ids) == 2:
+            parent[find(ids[0])] = find(ids[1])
+    comps: Dict[int, List[int]] = {}
+    for x in parent:
+        comps.setdefault(find(x), []).append(x)
+    return [tuple(sorted(v)) for v in comps.values()]
+
+
+def iter_collectives(compiled_text: str) -> Iterator[dict]:
+    """Yield one record per collective op in optimized HLO text:
+    ``{"op", "bytes", "groups", "line"}``. ``bytes`` is the result-type
+    byte size (tuple elements summed); ``groups`` is a list of sorted
+    device-id tuples (empty when the op prints no groups — a
+    single-partition degenerate)."""
+    for m in _HLO_COLLECTIVE.finditer(compiled_text):
+        rest = m.group("rest")
+        groups: List[Tuple[int, ...]] = []
+        ex = _REPLICA_EXPLICIT.search(rest)
+        it = _REPLICA_IOTA.search(rest)
+        st = _SOURCE_TARGET.search(rest)
+        if ex:
+            groups = [tuple(sorted(int(v) for v in g.group(1).split(",")
+                                   if v))
+                      for g in _GROUP_BODY.finditer(ex.group(1))]
+        elif it:
+            g, s = int(it.group(1)), int(it.group(2))
+            dims = [int(d) for d in it.group(3).split(",")]
+            perm = ([int(p) for p in it.group(4).split(",")]
+                    if it.group(4) else None)
+            groups = _iota_groups(g, s, dims, perm)
+        elif st:
+            groups = _permute_groups(st.group(1))
+        yield {
+            "op": m.group("op"),
+            "bytes": _hlo_shape_bytes(m.group("ty")),
+            "groups": groups,
+            "line": m.group(0).strip()[:200],
+        }
+
+
+def _axis_groups(shape: List[int], axes: List[int]) -> frozenset:
+    """Replica groups of a collective over the given mesh-axis subset,
+    assuming iota device order (how ``make_mesh`` lays devices out):
+    fix the other axes, vary ``axes``."""
+    strides = [1] * len(shape)
+    for i in range(len(shape) - 2, -1, -1):
+        strides[i] = strides[i + 1] * shape[i + 1]
+    fixed = [i for i in range(len(shape)) if i not in axes]
+    groups: List[Tuple[int, ...]] = []
+
+    def rec_fixed(idx: int, base: int) -> None:
+        if idx == len(fixed):
+            group: List[int] = []
+
+            def rec_var(jdx: int, off: int) -> None:
+                if jdx == len(axes):
+                    group.append(base + off)
+                    return
+                a = axes[jdx]
+                for v in range(shape[a]):
+                    rec_var(jdx + 1, off + v * strides[a])
+
+            rec_var(0, 0)
+            groups.append(tuple(sorted(group)))
+            return
+        i = fixed[idx]
+        for v in range(shape[i]):
+            rec_fixed(idx + 1, base + v * strides[i])
+
+    rec_fixed(0, 0)
+    return frozenset(groups)
+
+
+def attribute_axis(groups: List[Tuple[int, ...]], mesh_shape: List[int],
+                   axis_names: List[str]) -> str:
+    """Label a collective's replica groups with the smallest mesh-axis
+    subset whose iota-order groups match exactly: ``"data"``,
+    ``"model"``, ``"data+model"``, … — or ``"other"`` when no subset
+    reproduces the groups (a manual collective or a permute ring that
+    does not follow mesh axes)."""
+    from itertools import combinations
+
+    key = frozenset(tuple(sorted(g)) for g in groups)
+    for r in range(1, len(mesh_shape) + 1):
+        for combo in combinations(range(len(mesh_shape)), r):
+            if _axis_groups(mesh_shape, list(combo)) == key:
+                return "+".join(axis_names[i] for i in combo)
+    return "other"
 
 
 def count_host_markers(text: str) -> Dict[str, int]:

@@ -67,6 +67,18 @@ Rules (names are the ``check`` field of emitted violations):
     Scoped to the whole engine module on purpose: a sync in a helper
     called from dispatch stalls the pipeline exactly the same way.
 
+``unsharded-pjit``
+    A ``jax.jit``/``pjit`` call or decorator inside the SPMD code
+    paths (modules under ``perceiver_tpu/parallel/`` and
+    ``perceiver_tpu/training/spmd.py``) that omits explicit
+    ``in_shardings`` or ``out_shardings``. Silent sharding propagation
+    is how replication sneaks in: GSPMD happily materializes an
+    unconstrained operand fully replicated, and nothing fails until a
+    real slice runs out of HBM — declare the layout at every pjit
+    boundary and let ``replication_check`` verify what lowering did
+    with it. Single-device jits that truly have no layout (rare in
+    these modules) suppress per line with a reason.
+
 ``router-blocking-io``
     Blocking socket I/O without a deadline inside the fleet's
     router/replica hot paths (modules under ``perceiver_tpu/fleet/``):
@@ -559,6 +571,59 @@ def _check_router_blocking_io(tree: ast.AST, path: str) -> List[Violation]:
     return out
 
 
+def _is_pjit_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "pjit"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "pjit"
+    return False
+
+
+_SHARDING_KWARGS = {"in_shardings", "out_shardings"}
+
+
+def _check_unsharded_pjit(tree: ast.AST, path: str) -> List[Violation]:
+    """``unsharded-pjit``: jit/pjit in the SPMD modules without
+    explicit in_shardings AND out_shardings (see module docstring).
+    Covers the call form, the ``@partial(jax.jit, ...)`` decorator,
+    and the bare ``@jax.jit`` decorator."""
+    out: List[Violation] = []
+
+    def flag(lineno: int, missing) -> None:
+        out.append(Violation(
+            check="unsharded-pjit", where=f"{path}:{lineno}",
+            message=f"jit/pjit without explicit {'/'.join(missing)} "
+                    "in an SPMD module — silent sharding propagation "
+                    "is how replication sneaks in; declare the layout "
+                    "at the pjit boundary (parallel/sharding.py specs) "
+                    "or suppress with 'graphcheck: ignore' and a "
+                    "reason"))
+
+    for node in ast.walk(tree):
+        kws = None
+        if isinstance(node, ast.Call):
+            if _is_jit_expr(node.func) or _is_pjit_expr(node.func):
+                kws = node.keywords
+            elif _is_partial_expr(node.func) and any(
+                    _is_jit_expr(a) or _is_pjit_expr(a)
+                    for a in node.args):
+                kws = node.keywords
+        if kws is None:
+            continue
+        missing = sorted(_SHARDING_KWARGS
+                         - {kw.arg for kw in kws if kw.arg})
+        if missing:
+            flag(node.lineno, missing)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                # bare @jax.jit — the Call forms were handled above
+                if not isinstance(dec, ast.Call) and (
+                        _is_jit_expr(dec) or _is_pjit_expr(dec)):
+                    flag(dec.lineno, sorted(_SHARDING_KWARGS))
+    return out
+
+
 def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     """Lint one module's source. ``path`` is used for reporting and
     for the ops-scoped rule (a path containing ``/ops/``)."""
@@ -573,6 +638,9 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
         violations.extend(_check_engine_syncs(tree, imports, path))
     if "perceiver_tpu/fleet/" in norm:
         violations.extend(_check_router_blocking_io(tree, path))
+    if "perceiver_tpu/parallel/" in norm \
+            or norm.endswith("perceiver_tpu/training/spmd.py"):
+        violations.extend(_check_unsharded_pjit(tree, path))
     if "perceiver_tpu/cache/" not in norm:
         violations.extend(_check_uncached_compiles(tree, path))
     if "/ops/" in norm and {"numpy", "jax.numpy"} <= imports.top_level:
@@ -626,7 +694,8 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
 
 ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
              "impl-field-validation", "serving-host-sync",
-             "uncached-compile", "silent-swallow", "router-blocking-io")
+             "uncached-compile", "silent-swallow", "router-blocking-io",
+             "unsharded-pjit")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

@@ -295,14 +295,24 @@ def run_graph_checks(targets: Sequence[StepTarget] = CANONICAL_TARGETS,
     ONE fresh trace, which turns ``cache_key_stability`` into a
     cross-process check and halves (``--graph``) or removes
     (``--graph --fast``) the lowering bill of a warm run."""
+    from perceiver_tpu.analysis import shardcheck
+
     report = Report()
     fingerprints = {}
     budgets = load_hbm_budgets()
+    shard_budgets = shardcheck.load_shard_budgets()
     for target in targets:
         lowered = lower_target(target, cache=cache)
         report.extend(hbm_budget(lowered.bytes_accessed,
                                  where=target.name, budgets=budgets))
         report.ran("hbm_budget")
+        if target.mesh is not None:
+            vs, _inventory = shardcheck.run_shard_passes(
+                lowered, budgets=shard_budgets)
+            report.extend(vs)
+            report.ran("collective_budget")
+            report.ran("replication_check")
+            report.ran("per_shard_hbm_budget")
         vs, _summary = dtype_policy(
             lowered.text, where=target.name,
             allowlist=target.dtype_allow,
@@ -319,8 +329,10 @@ def run_graph_checks(targets: Sequence[StepTarget] = CANONICAL_TARGETS,
         report.ran("donation_check")
         if recompile:
             # the second lowering is always fresh — when `lowered`
-            # came from the cache this compares across processes
-            second = lower_target(target)
+            # came from the cache this compares across processes.
+            # want_compiled=False: the stability passes only compare
+            # StableHLO text, so mesh targets skip the XLA compile
+            second = lower_target(target, want_compiled=False)
             vs, fp = recompile_budget(target, first=lowered,
                                       second=second)
             report.extend(vs)

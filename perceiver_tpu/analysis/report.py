@@ -103,6 +103,18 @@ class TransferAllow:
     max_count: int = 1
 
 
+@dataclasses.dataclass(frozen=True)
+class ReplicationAllow:
+    """One ``replication_check`` allowlist entry: permits up to
+    ``max_count`` tensors of the given type string (``"8192x64xf32"``)
+    to live fully replicated above the size floor, with a recorded
+    reason (e.g. a read-only embedding table replicated by design)."""
+
+    type: str
+    reason: str
+    max_count: int = 1
+
+
 def apply_dtype_allowlist(records: List[dict],
                           allowlist: Tuple[DtypeAllow, ...]):
     """Split fp32+ matmul records into (allowed, violating) under the

@@ -17,7 +17,11 @@ import dataclasses
 from functools import partial
 from typing import Callable, Optional, Tuple
 
-from perceiver_tpu.analysis.report import DtypeAllow, TransferAllow
+from perceiver_tpu.analysis.report import (
+    DtypeAllow,
+    ReplicationAllow,
+    TransferAllow,
+)
 
 # The packed-CE overflow warning (tasks/mlm.py) lowers to one host
 # callback on backends that support them; on the axon TPU runtime the
@@ -38,6 +42,56 @@ _MLM_OVERFLOW_CALLBACK = (
 
 
 @dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh for a sharded target: ordered (axis, size)
+    pairs, outermost first — ``(("data", 2), ("model", 2))`` is the
+    dp2×tp2 layout ``parallel/mesh.make_mesh`` builds. Declarative so
+    targets stay import-cheap (no jax at module import) and the
+    descriptor can key caches/manifests without building devices."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(name for name, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(n for _, n in self.axes)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= s
+        return n
+
+    @property
+    def descriptor(self) -> str:
+        """Stable string identity: ``"data2_model2"`` — the manifest
+        key suffix and the lowering-cache key extra."""
+        return "_".join(f"{name}{n}" for name, n in self.axes)
+
+    def build(self):
+        """Mesh over the first ``n_devices`` devices in iota order —
+        the same layout ``parallel/mesh.make_mesh`` produces, and the
+        order the collective-attribution pass assumes. On CPU, run
+        under ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+        (conftest.py and scripts/check.py both force it)."""
+        import jax
+        import numpy as np
+
+        devices = jax.devices()
+        if len(devices) < self.n_devices:
+            raise ValueError(
+                f"mesh {self.descriptor} needs {self.n_devices} devices, "
+                f"backend has {len(devices)}; on CPU set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8")
+        arr = np.array(devices[:self.n_devices]).reshape(self.shape)
+        return jax.sharding.Mesh(arr, self.axis_names)
+
+
+@dataclasses.dataclass(frozen=True)
 class StepTarget:
     """One canonical (task config, input shapes) pair to lower and gate.
 
@@ -50,6 +104,12 @@ class StepTarget:
     is the task's serve graph (``serving/graphs.py``) at its bucket
     shapes — the exact executable ``ServingEngine`` AOT-compiles, so
     the gates certify the graph production dispatches.
+
+    ``mesh`` turns the target SPMD: the step is built with explicit
+    shardings over ``mesh.build()`` (``training/spmd.py`` /
+    ``serving/graphs.serve_graph_shardings``) and additionally
+    compiled, because GSPMD inserts collectives during SPMD
+    partitioning — the shardcheck passes parse the optimized HLO.
     """
 
     name: str
@@ -59,6 +119,8 @@ class StepTarget:
     transfer_allow: Tuple[TransferAllow, ...] = ()
     dtype_allow: Tuple[DtypeAllow, ...] = ()
     kind: str = "train"
+    mesh: Optional[MeshSpec] = None
+    replication_allow: Tuple[ReplicationAllow, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,6 +145,10 @@ class LoweredStep:
     # process's lowering of the same source tree) instead of a fresh
     # trace — see perceiver_tpu/cache
     cached: bool = False
+    # optimized-HLO text of the compiled executable — mesh targets
+    # only (GSPMD collectives exist nowhere else). None when the
+    # target is unsharded or the caller asked to skip compilation.
+    compiled_text: Optional[str] = None
 
 
 def cost_bytes_accessed(lowered) -> Optional[float]:
@@ -168,7 +234,31 @@ def make_packed_serve_step(task, batch):
     return jitted, args, expected
 
 
-def lower_target(target: StepTarget, cache=None) -> LoweredStep:
+def make_sharded_serve_step(task, batch, mesh):
+    """The sharded serve-graph jit: the same graph + donation layout
+    as ``make_serve_step``, under explicit GSPMD shardings (params
+    tensor-parallel on ``model``, request/response batch axes on
+    ``data``). Returns ``(jitted_fn, args, expected_donated)``."""
+    import jax
+
+    from perceiver_tpu.serving.graphs import (
+        build_serve_graph,
+        serve_graph_shardings,
+    )
+
+    graph = build_serve_graph(task)
+    params = graph.init_params()
+    p_sh, in_sh, out_sh = serve_graph_shardings(graph, params, mesh)
+    args = (params,) + tuple(batch[spec.name] for spec in graph.inputs)
+    jitted = jax.jit(graph.fn, donate_argnums=graph.donate_argnums,
+                     in_shardings=(p_sh,) + in_sh, out_shardings=out_sh)
+    donated_args = tuple(args[i] for i in graph.donate_argnums)
+    expected = len(jax.tree_util.tree_leaves(donated_args))
+    return jitted, args, expected
+
+
+def lower_target(target: StepTarget, cache=None,
+                 want_compiled: bool = True) -> LoweredStep:
     """Build the target's task + batch, lower its step (train or
     serve), and package the properties the graph passes gate on.
 
@@ -177,23 +267,46 @@ def lower_target(target: StepTarget, cache=None) -> LoweredStep:
     to the jax/jaxlib versions, the backend topology, and a content
     hash of the whole source tree, so a hit is exactly the text a
     fresh trace of this code would produce — and any code edit is a
-    miss. Fresh lowerings are stored back for the next process."""
+    miss. Fresh lowerings are stored back for the next process.
+
+    Mesh targets are also XLA-compiled (collectives exist only in
+    optimized HLO); the compiled text rides in the lowering record so
+    warm ``check.py`` runs stay compile-free. ``want_compiled=False``
+    skips that compile for callers that only need StableHLO (the
+    recompile-stability re-lowering)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     key = None
     if cache is not None:
-        key = cache.lowering_key(target.name)
+        extra = (target.mesh.descriptor,) if target.mesh else ()
+        key = cache.lowering_key(target.name, extra=extra)
         record = cache.load_lowering(key)
-        if record is not None:
+        # a record stored by a want_compiled=False lowering of a mesh
+        # target has no compiled text — useless to the collective
+        # passes, so fall through to a fresh lowering
+        usable = record is not None and not (
+            target.mesh and want_compiled
+            and not record.get("compiled_text"))
+        if usable:
             return LoweredStep(
                 target=target, text=record["text"],
                 expected_donated=int(record["expected_donated"]),
                 task_hash=None,
                 bytes_accessed=record.get("bytes_accessed"),
-                cached=True)
+                cached=True,
+                compiled_text=record.get("compiled_text"))
     task, batch = target.build()
-    if target.kind == "serve":
+    mesh = target.mesh.build() if target.mesh else None
+    if mesh is not None and target.kind == "train":
+        from perceiver_tpu.training.spmd import make_sharded_train_step
+
+        step, args = make_sharded_train_step(task, batch, mesh)
+        params, opt_state = args[0], args[1]
+        expected = len(jax.tree_util.tree_leaves((params, opt_state)))
+    elif mesh is not None and target.kind == "serve":
+        step, args, expected = make_sharded_serve_step(task, batch, mesh)
+    elif target.kind == "serve":
         step, args, expected = make_serve_step(task, batch)
     elif target.kind == "packed_serve":
         step, args, expected = make_packed_serve_step(task, batch)
@@ -202,10 +315,18 @@ def lower_target(target: StepTarget, cache=None) -> LoweredStep:
         params, opt_state = args[0], args[1]
         expected = len(jax.tree_util.tree_leaves((params, opt_state)))
     lowered = step.lower(*args)
+    compiled_text = None
+    if mesh is not None and want_compiled:
+        from perceiver_tpu.cache import compile_lowered
+
+        compiled_text = compile_lowered(lowered).as_text()
     result = LoweredStep(target=target, text=lowered.as_text(),
                          expected_donated=expected, task_hash=hash(task),
-                         bytes_accessed=cost_bytes_accessed(lowered))
-    if cache is not None:
+                         bytes_accessed=cost_bytes_accessed(lowered),
+                         compiled_text=compiled_text)
+    # a compile-less mesh lowering must not overwrite (or seed) a
+    # record — warm runs would then miss compiled text forever
+    if cache is not None and not (target.mesh and compiled_text is None):
         from perceiver_tpu.analysis import hlo
 
         cache.store_lowering(key, {
@@ -215,6 +336,8 @@ def lower_target(target: StepTarget, cache=None) -> LoweredStep:
             "bytes_accessed": result.bytes_accessed,
             "fingerprint": hlo.module_fingerprint(result.text),
             "text_hash": hlo.text_hash(result.text),
+            **({"compiled_text": compiled_text, "mesh": target.mesh.descriptor}
+               if target.mesh else {}),
         })
     return result
 
@@ -447,6 +570,49 @@ PACKED_SERVING_TARGETS = (
 )
 
 
+# --------------------------------------------------------------------------
+# Sharded (SPMD) targets: the first mesh rung — dp2×tp2 over 4 CPU
+# devices (virtual via --xla_force_host_platform_device_count; the
+# same specs place on a v4-8 slice unchanged). Shapes shrink from the
+# headline rung so lower+compile stays seconds, and vocab drops to
+# 8192 so the model axis divides the vocab projection evenly (the odd
+# 10003 vocab would fall back to replication — exactly what the
+# replication pass exists to flag).
+
+DP2_TP2 = MeshSpec(axes=(("data", 2), ("model", 2)))
+
+_SPMD_MLM = dict(batch=32, channels=64, seq_len=256, vocab=8192)
+
+
+def _build_mlm_spmd():
+    return _build_mlm(loss_impl="packed", **_SPMD_MLM)
+
+
+def _serve_batch_mlm_spmd():
+    return _serve_batch_mlm(**_SPMD_MLM)
+
+
+# the input embedding table (vocab×C fp32) is replicated by design:
+# the sharding rules keep embeddings whole on every device (read-only
+# per step, gathered by token id), and only its ZeRO moments shard
+_SPMD_MLM_EMBED_ALLOW = (
+    ReplicationAllow(
+        type="8192x64xf32", max_count=2,
+        reason="input-embedding table (and its aliased output copy) — "
+               "replicated by design per parallel/sharding.py; its "
+               "optimizer moments ARE data-sharded (ZeRO)"),
+)
+
+SHARDED_TARGETS = (
+    StepTarget(name="mlm_spmd_b32_s256_dp2_tp2", build=_build_mlm_spmd,
+               mesh=DP2_TP2, transfer_allow=_MLM_OVERFLOW_CALLBACK,
+               replication_allow=_SPMD_MLM_EMBED_ALLOW),
+    StepTarget(name="serve_mlm_spmd_b32_s256_dp2_tp2",
+               build=_serve_batch_mlm_spmd, kind="serve", mesh=DP2_TP2,
+               replication_allow=_SPMD_MLM_EMBED_ALLOW),
+)
+
+
 # The headline MLM rung (bench.py _LADDER[0]: B=512/C=64/packed) plus
 # one target per remaining task at its canonical shapes, plus the
 # serving targets. "fast" targets keep tracing under a few seconds for
@@ -458,7 +624,11 @@ CANONICAL_TARGETS = (
     StepTarget(name="text_clf_b64", build=_build_text_clf),
     StepTarget(name="img_clf_b512", build=_build_img_clf),
     StepTarget(name="seg_512x512_b1", build=_build_seg),
-) + SERVING_TARGETS + PACKED_SERVING_TARGETS
+) + SERVING_TARGETS + PACKED_SERVING_TARGETS + SHARDED_TARGETS
 
+# --fast also drops the mesh targets: they are the only targets that
+# must be XLA-COMPILED (collectives appear post-partitioning), and the
+# fast tier exists to keep the tier-1 wall clock bounded. --all and
+# --graph still run them, which is where the shardcheck gates live.
 FAST_TARGETS = tuple(t for t in CANONICAL_TARGETS
-                     if t.name != "seg_512x512_b1")
+                     if t.name != "seg_512x512_b1" and t.mesh is None)

@@ -87,6 +87,50 @@ def shard_params(params, mesh: Mesh):
     return jax.device_put(params, param_sharding(params, mesh))
 
 
+def zero_sharding(opt_state, mesh: Mesh):
+    """ZeRO-style optimizer-state sharding (SURVEY §2.5; the pjit
+    re-expression of torch's sharded optimizer / ZeRO stage 1).
+
+    Optimizer moments mirror the parameter pytree, so each leaf first
+    inherits its parameter's tensor-parallel spec. Any leaf the param
+    rules leave (partly) replicated — embeddings, norms, latents, and
+    every model-sharded weight's untouched dims — then shards its
+    first still-replicated dim that the ``data`` axis divides, so no
+    device holds a full copy of any large moment. Scalar leaves
+    (adam step counts) and leaves with no divisible dim stay
+    replicated. Leaves that don't mirror a parameter (count arrays,
+    empty states) get the same first-divisible-dim treatment from a
+    blank spec."""
+    data = mesh.shape.get("data", 1)
+    has_model = "model" in mesh.axis_names and \
+        mesh.shape.get("model", 1) > 1
+
+    def _data_shard(spec: tuple, shape) -> P:
+        spec = spec + (None,) * (len(shape) - len(spec))
+        out = list(spec)
+        for d, ax in enumerate(out):
+            if ax is None and shape[d] % data == 0 and shape[d] > 1:
+                out[d] = "data"
+                break
+        return P(*out)
+
+    def spec(path, leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return NamedSharding(mesh, P())
+        names = _names(path)
+        base = _trailing_spec(names, leaf.ndim) if has_model else ()
+        base = (None,) * (leaf.ndim - len(base)) + base
+        fixed = tuple(
+            ax if ax is None or leaf.shape[d] % mesh.shape[ax] == 0
+            else None
+            for d, ax in enumerate(base))
+        if data > 1:
+            return NamedSharding(mesh, _data_shard(fixed, leaf.shape))
+        return NamedSharding(mesh, P(*fixed))
+
+    return jax.tree_util.tree_map_with_path(spec, opt_state)
+
+
 def batch_sharding(mesh: Mesh, extra: Optional[tuple] = None):
     """Batch-axis (data-parallel) sharding for input arrays."""
     return NamedSharding(mesh, P("data", *(extra or ())))

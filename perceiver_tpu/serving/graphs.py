@@ -216,6 +216,25 @@ def build_serve_graph(task, *, policy: Policy = DEFAULT_POLICY,
         "ImageClassifierTask, SegmentationTask")
 
 
+def serve_graph_shardings(graph: ServeGraph, params, mesh):
+    """GSPMD shardings for a serve graph's jit over a data×model mesh:
+    params take the tensor-parallel layout (``parallel/sharding``),
+    request tensors and every output shard their leading (batch) axis
+    over ``data``. Donation survives sharding — a donated request
+    buffer and the output it aliases carry the same spec, so the
+    per-shard buffers still alias in place. Returns
+    ``(params_sharding, input_shardings, output_shardings)`` ready for
+    ``jax.jit(graph.fn, in_shardings=..., out_shardings=...)``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from perceiver_tpu.parallel.sharding import param_sharding
+
+    batch_sh = NamedSharding(mesh, P("data"))
+    return (param_sharding(params, mesh),
+            tuple(batch_sh for _ in graph.inputs),
+            {name: batch_sh for name in graph.output_names})
+
+
 # --- packed (ragged) serve graphs --------------------------------------------
 #
 # The packed path replaces the [B, S] rectangle with one concatenated

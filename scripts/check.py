@@ -25,6 +25,15 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+# The sharded canonical targets place over a 4-device mesh; on CPU
+# that needs virtual devices, and the flag only takes effect if set
+# before the first jax import (same recipe as tests/conftest.py).
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(
@@ -62,20 +71,40 @@ def main() -> int:
                          "targets absent from hbm_budgets.json (the "
                          "new-target path — existing pins are copied "
                          "through untouched, never re-baselined)")
+    ap.add_argument("--no-mesh", action="store_true",
+                    help="skip the sharded (SPMD) canonical targets "
+                         "and their shardcheck passes — the escape "
+                         "hatch for environments that cannot simulate "
+                         "a multi-device backend")
+    ap.add_argument("--rebaseline-shard", action="store_true",
+                    help="re-measure every sharded target's per-axis "
+                         "collective bytes + per-shard bytes and "
+                         "rewrite the shard_budgets.json manifest "
+                         "(only after an INTENTIONAL sharding change "
+                         "— commit the manifest diff with the "
+                         "justification)")
+    ap.add_argument("--pin-missing-shard", action="store_true",
+                    help="measure and pin shard budgets ONLY for "
+                         "sharded targets absent from "
+                         "shard_budgets.json (existing pins copied "
+                         "through untouched)")
     args = ap.parse_args()
     if not (args.all or args.lint or args.graph or args.rebaseline_hbm
-            or args.pin_missing_hbm):
+            or args.pin_missing_hbm or args.rebaseline_shard
+            or args.pin_missing_shard):
         args.all = True
 
     from perceiver_tpu.analysis import (
         CANONICAL_TARGETS,
         FAST_TARGETS,
         Report,
+        collective_inventory,
         default_lint_paths,
         lint_paths,
         lower_target,
         run_graph_checks,
         write_hbm_budgets,
+        write_shard_budgets,
     )
 
     if args.rebaseline_hbm or args.pin_missing_hbm:
@@ -109,6 +138,57 @@ def main() -> int:
             print("[check] hbm_budgets.json rewritten — commit it with "
                   "the change that justified the re-baseline",
                   file=sys.stderr)
+        if not (args.all or args.lint or args.graph
+                or args.rebaseline_shard or args.pin_missing_shard):
+            return 0
+
+    if args.rebaseline_shard or args.pin_missing_shard:
+        import datetime
+
+        from perceiver_tpu.analysis import (
+            SHARDED_TARGETS,
+            load_shard_budgets,
+        )
+
+        keep = {}
+        stargets = SHARDED_TARGETS
+        if args.pin_missing_shard and not args.rebaseline_shard:
+            keep = load_shard_budgets()
+            stargets = [t for t in SHARDED_TARGETS if t.name not in keep]
+            if not stargets:
+                print("[check] every sharded target already has pinned "
+                      "shard budgets — nothing to do", file=sys.stderr)
+        measured = {}
+        for target in stargets:
+            print(f"[check] lowering+compiling {target.name} ...",
+                  file=sys.stderr)
+            lowered = lower_target(target)
+            if lowered.bytes_accessed is None \
+                    or not lowered.compiled_text:
+                print(f"[check] {target.name}: no cost analysis or "
+                      "compiled HLO — cannot pin shard budgets",
+                      file=sys.stderr)
+                return 1
+            inv = collective_inventory(lowered.compiled_text,
+                                       target.mesh)
+            per_shard = lowered.bytes_accessed / target.mesh.n_devices
+            measured[target.name] = {
+                "mesh": target.mesh.descriptor,
+                "collectives": inv["collectives"],
+                "ops": inv["ops"],
+                "per_shard": per_shard,
+            }
+            traffic = {a: f"{b / 1e6:.2f}MB"
+                       for a, b in sorted(inv["collectives"].items())}
+            print(f"[check] {target.name}: per-shard "
+                  f"{per_shard / 1e9:.2f} GB, collectives {traffic}",
+                  file=sys.stderr)
+        if measured:
+            write_shard_budgets(
+                measured, note=str(datetime.date.today()), keep=keep)
+            print("[check] shard_budgets.json rewritten — commit it "
+                  "with the change that justified the re-baseline",
+                  file=sys.stderr)
         if not (args.all or args.lint or args.graph):
             return 0
 
@@ -134,6 +214,8 @@ def main() -> int:
         report.merge(lint_paths(paths))
     if args.all or args.graph:
         targets = FAST_TARGETS if args.fast else CANONICAL_TARGETS
+        if args.no_mesh:
+            targets = tuple(t for t in targets if t.mesh is None)
         print(f"[check] lowering {len(targets)} canonical target(s) "
               "(CPU backend; no chip needed) ...", file=sys.stderr)
         report.merge(run_graph_checks(targets, recompile=not args.fast,

@@ -92,6 +92,7 @@ _SLOW = {
     "test_spmd_attention_impls.py::test_matches_einsum_baseline[seqpar-4]",
     "test_graphcheck.py::test_full_graph_sweep_is_clean",
     "test_graphcheck.py::test_full_lint_sweep_is_clean",
+    "test_shardcheck.py::test_tiny_sharded_target_end_to_end",
     "test_exec_cache.py::test_bench_startup_script_cold_warm",
     "test_resilience.py::test_trainer_skip_policy_survives_isolated_nan_steps",
     "test_resilience.py::test_trainer_streak_rewinds_from_verified_anchor",

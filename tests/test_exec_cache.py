@@ -242,6 +242,34 @@ class TestLoweringRecords:
         assert cache.lowering_key("t") != k1, \
             "a source edit must invalidate lowering records"
 
+    def test_key_forks_on_mesh_descriptor(self, tmp_path):
+        """The same target lowered over two meshes must be two records
+        — the shardings (and therefore the GSPMD collectives in the
+        stored compiled text) differ per topology, so serving a
+        data2_model2 record to a data4_model1 run would gate the wrong
+        graph. ``lower_target`` passes ``mesh.descriptor`` as the key
+        extra; axis NAMING forks too (a renamed axis changes every
+        PartitionSpec even at the same shape)."""
+        cache = _cache(tmp_path)
+        keys = {cache.lowering_key("t", extra=extra)
+                for extra in ((), ("data2_model2",), ("data4_model1",),
+                              ("batch2_shard2",))}
+        assert len(keys) == 4, "mesh descriptor must be key material"
+        # and the record served back is the one stored under that mesh
+        k22 = cache.lowering_key("t", extra=("data2_model2",))
+        k41 = cache.lowering_key("t", extra=("data4_model1",))
+        cache.store_lowering(k22, {"text": "module @m22 {}",
+                                   "expected_donated": 0,
+                                   "compiled_text": "HloModule m22",
+                                   "mesh": "data2_model2"})
+        cache.store_lowering(k41, {"text": "module @m41 {}",
+                                   "expected_donated": 0,
+                                   "compiled_text": "HloModule m41",
+                                   "mesh": "data4_model1"})
+        assert cache.load_lowering(k22)["mesh"] == "data2_model2"
+        assert cache.load_lowering(k41)["compiled_text"] == \
+            "HloModule m41"
+
 
 class TestStepFlopsCachePath:
     def test_hit_returns_sidecar_flops_and_executable(self, tmp_path):

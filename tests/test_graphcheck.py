@@ -959,11 +959,11 @@ def test_full_graph_sweep_is_clean(monkeypatch, lowered_target_cache):
 
     first_seen = set()
 
-    def once_cached(target, cache=None):
+    def once_cached(target, cache=None, **kwargs):
         if target.name not in first_seen:
             first_seen.add(target.name)
             return lowered_target_cache(target)
-        return real_lower(target)
+        return real_lower(target, **kwargs)
 
     monkeypatch.setattr(passes_mod, "lower_target", once_cached)
     report = run_graph_checks(CANONICAL_TARGETS, recompile=True)
@@ -971,15 +971,22 @@ def test_full_graph_sweep_is_clean(monkeypatch, lowered_target_cache):
     assert set(report.checks_run) == {"dtype_policy", "transfer_guard",
                                       "donation_check",
                                       "recompile_budget", "hbm_budget",
-                                      "cache_key_stability"}
+                                      "cache_key_stability",
+                                      "collective_budget",
+                                      "replication_check",
+                                      "per_shard_hbm_budget"}
 
 
 def test_check_cli_all_exits_zero():
     """``scripts/check.py --all`` — the literal merge gate, as the
     literal subprocess CI runs — exits 0 on this tree. Tier-1 (not
     slow-marked): graphcheck + hbm_budget only gate merges if the
-    fast suite actually runs them."""
+    fast suite actually runs them. Also pins the check roster: the
+    sharded targets must be in the default sweep and the three
+    shardcheck passes must have actually run (a gate that silently
+    stops running is worse than none)."""
     import os
+    import re
     import subprocess
     import sys
 
@@ -990,6 +997,15 @@ def test_check_cli_all_exits_zero():
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
         capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    m = re.search(r"from (\d+) check\(s\): (.*)", r.stdout)
+    assert m, r.stdout
+    n_checks, roster = int(m.group(1)), m.group(2)
+    assert n_checks >= 18, r.stdout
+    for shard_pass in ("collective_budget", "replication_check",
+                       "per_shard_hbm_budget", "unsharded-pjit"):
+        assert shard_pass in roster, r.stdout
+    m = re.search(r"lowering (\d+) canonical target", r.stderr)
+    assert m and int(m.group(1)) == len(CANONICAL_TARGETS), r.stderr
 
 
 def test_check_cli_exec_cache_second_run_warm():
@@ -1028,8 +1044,9 @@ def test_check_cli_exec_cache_second_run_warm():
             assert m, stderr
             return tuple(int(g) for g in m.groups())
 
-        n = len([t for t in CANONICAL_TARGETS
-                 if t.name != "seg_512x512_b1"])
+        from perceiver_tpu.analysis import FAST_TARGETS
+
+        n = len(FAST_TARGETS)
         assert stats(r1.stderr) == (0, n, n, stats(r1.stderr)[3])
         hits, misses, stores, compiles = stats(r2.stderr)
         assert (hits, misses, stores) == (n, 0, 0)

@@ -9,8 +9,16 @@ and train through the full Trainer path: per-host dataset sharding,
 gradient all-reduce across processes, the prepare_data barrier, and
 multi-host eval aggregation — the NCCL/DDP-equivalent story, actually
 multi-process.
+
+Not every jaxlib CPU wheel ships cross-process collectives (Gloo):
+some builds form the cluster fine and then reject the first collective
+with ``INVALID_ARGUMENT: Multiprocess computations aren't implemented
+on the CPU backend``. A cached two-process probe detects exactly that
+signature and skips — any OTHER failure (hang, crash, wrong metrics)
+still fails loudly, so the skip cannot hide a real regression.
 """
 
+import functools
 import json
 import os
 import socket
@@ -28,6 +36,56 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# the smallest program that exercises a cross-process collective on
+# the CPU backend: cluster init + one broadcast_one_to_all
+_PROBE_SRC = """\
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
+                           num_processes=2, process_id=int(sys.argv[1]))
+import numpy as np
+from jax.experimental import multihost_utils
+multihost_utils.broadcast_one_to_all(np.ones((2,)))
+print("PROBE-OK")
+"""
+
+_NO_CPU_COLLECTIVES = ("Multiprocess computations aren't implemented "
+                       "on the CPU backend")
+
+
+@functools.lru_cache(maxsize=1)
+def _cpu_multiprocess_collectives_error():
+    """The known unsupported-backend signature if this jaxlib's CPU
+    backend cannot run cross-process collectives, else None. Cached:
+    both parametrizations share one ~15 s probe instead of each paying
+    a full worker startup just to hit the same error."""
+    port = _free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SRC.format(port=port), str(i)],
+        env=env, cwd=ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        # a hang is NOT the known signature — run the real test and
+        # let it fail loudly
+        return None
+    if any(p.returncode != 0 for p in procs) \
+            and any(_NO_CPU_COLLECTIVES in o for o in outs):
+        return _NO_CPU_COLLECTIVES
+    return None
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("devices_per_proc,model_parallel", [
     (1, 1),   # pure dp over 2 processes (the reference's DDP shape)
@@ -41,6 +99,10 @@ def _free_port() -> int:
 ])
 def test_two_process_distributed_training(tmp_path, devices_per_proc,
                                           model_parallel):
+    err = _cpu_multiprocess_collectives_error()
+    if err:
+        pytest.skip("this jaxlib's CPU backend cannot run "
+                    f"cross-process collectives: {err}")
     port = _free_port()
     outs = [tmp_path / f"out_{i}.json" for i in range(2)]
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
