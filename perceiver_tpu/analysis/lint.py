@@ -79,6 +79,19 @@ Rules (names are the ``check`` field of emitted violations):
     with it. Single-device jits that truly have no layout (rare in
     these modules) suppress per line with a reason.
 
+``metrics-conventions``
+    Prometheus naming discipline at every metric registration site —
+    a ``.counter("name", ...)``/``.gauge(...)``/``.histogram(...)``
+    call with a string-literal name. Names must be snake_case with a
+    plane prefix (``serving_``/``training_``/``fleet_``) so one fleet
+    exposition can merge replica, router, and trainer series without
+    collisions; counters must end ``_total`` (the exposition suffix
+    convention scrapers and recording rules key on) and gauges/
+    histograms must not (``_total`` on a non-counter misleads every
+    rate() written against it). Misnamed metrics don't fail at
+    registration — they fail months later in dashboards that filter
+    on the suffix.
+
 ``router-blocking-io``
     Blocking socket I/O without a deadline inside the fleet's
     router/replica hot paths (modules under ``perceiver_tpu/fleet/``):
@@ -105,6 +118,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Dict, Iterable, List, Optional, Set
 
 from perceiver_tpu.analysis.report import Report, Violation
@@ -571,6 +585,47 @@ def _check_router_blocking_io(tree: ast.AST, path: str) -> List[Violation]:
     return out
 
 
+# metric registration sites: one naming convention for all planes
+_METRIC_KINDS = {"counter", "gauge", "histogram"}
+_METRIC_NAME_RE = re.compile(r"^(serving|training|fleet)_[a-z0-9_]+$")
+
+
+def _check_metrics_conventions(tree: ast.AST,
+                               path: str) -> List[Violation]:
+    """``metrics-conventions``: see the module docstring. Only
+    string-literal first arguments are checked — a computed name is a
+    different smell, but not one an AST pass can validate."""
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_KINDS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        kind, name = node.func.attr, node.args[0].value
+        problems = []
+        if not _METRIC_NAME_RE.match(name):
+            problems.append(
+                "must be snake_case with a serving_/training_/fleet_ "
+                "plane prefix")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append("counters must end in _total")
+        if kind != "counter" and name.endswith("_total"):
+            problems.append(f"{kind}s must not end in _total "
+                            "(reserved for counters)")
+        for problem in problems:
+            out.append(Violation(
+                check="metrics-conventions",
+                where=f"{path}:{node.lineno}",
+                message=f"metric {name!r} registered via .{kind}() — "
+                        f"{problem}; one naming scheme keeps the "
+                        "merged fleet exposition collision-free and "
+                        "rate()-able (docs/OBSERVABILITY.md)"))
+    return out
+
+
 def _is_pjit_expr(node: ast.AST) -> bool:
     if isinstance(node, ast.Name):
         return node.id == "pjit"
@@ -632,6 +687,7 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     imports.visit(tree)
     violations: List[Violation] = []
     violations.extend(_check_silent_swallow(tree, src.splitlines(), path))
+    violations.extend(_check_metrics_conventions(tree, path))
 
     norm = path.replace(os.sep, "/")
     if norm.endswith("serving/engine.py"):
@@ -695,7 +751,7 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
 ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
              "impl-field-validation", "serving-host-sync",
              "uncached-compile", "silent-swallow", "router-blocking-io",
-             "unsharded-pjit")
+             "unsharded-pjit", "metrics-conventions")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

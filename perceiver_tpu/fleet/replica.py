@@ -45,9 +45,11 @@ import argparse
 import json
 import sys
 import threading
+import time
 from typing import Optional
 
 from perceiver_tpu.fleet.rpc import RpcServer
+from perceiver_tpu.obs import trace as trace_mod
 from perceiver_tpu.resilience import faults
 from perceiver_tpu.serving.api import materialize, materialize_packed
 from perceiver_tpu.serving.errors import Unavailable
@@ -133,7 +135,8 @@ class ReplicaServer:
     def handle(self, request: dict):
         op = request.get("op")
         if op == "dispatch":
-            return self._dispatch(request["arrays"])
+            return self._dispatch(request["arrays"],
+                                  request.get("trace"))
         if op == "status":
             return self._status()
         if op == "update_version":
@@ -147,7 +150,13 @@ class ReplicaServer:
             return "bye"
         raise ValueError(f"unknown op {op!r}")
 
-    def _dispatch(self, arrays: dict) -> dict:
+    def _dispatch(self, arrays: dict, wire: Optional[dict] = None) -> dict:
+        # rehydrate the caller's trace (if it sent one) into a local
+        # span collector — the spans ride back in the reply and the
+        # router re-keys them into the request's trace
+        collector = trace_mod.SpanCollector()
+        ctx = trace_mod.from_wire(wire, sink=collector, origin="replica")
+        admit_start = time.monotonic()
         with self._lock:
             if self._swapping:
                 # mid-swap: typed rejection the router retries on a
@@ -158,20 +167,29 @@ class ReplicaServer:
         try:
             faults.maybe_stall("replica.stall")
             faults.maybe_kill("replica.crash")
-            if "packed_ids" in arrays:
-                result = self.engine.dispatch_packed(arrays)
-                outputs = materialize_packed(result,
-                                             self.engine.packed_graph)
-            else:
-                result = self.engine.dispatch(arrays)
-                outputs = materialize(result, self.engine.graph)
+            if ctx is not None:
+                # admission (lock/stall wait) is this replica's queue
+                ctx.record("queue_wait", start=admit_start)
+            with trace_mod.attach([ctx]):
+                if "packed_ids" in arrays:
+                    result = self.engine.dispatch_packed(arrays)
+                    with trace_mod.region("device"):
+                        outputs = materialize_packed(
+                            result, self.engine.packed_graph)
+                else:
+                    result = self.engine.dispatch(arrays)
+                    with trace_mod.region("device"):
+                        outputs = materialize(result, self.engine.graph)
         finally:
             with self._lock:
                 self._inflight -= 1
                 self._idle.notify_all()
-        return {"outputs": outputs,
-                "health": self.engine.health.state.name,
-                "version": self.version}
+        reply = {"outputs": outputs,
+                 "health": self.engine.health.state.name,
+                 "version": self.version}
+        if ctx is not None:
+            reply["spans"] = collector.spans
+        return reply
 
     def _status(self) -> dict:
         metrics = self.engine.metrics

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from perceiver_tpu.obs import events as events_mod
+
 
 class RolloutAborted(RuntimeError):
     """The rolling update failed and was rolled back.
@@ -52,14 +54,20 @@ def _cutover(fleet, rid: str, version: str, *,
     """Steps 1-4 for one replica; raises on verification/swap failure
     with the replica undrained (it still serves its old version)."""
     fleet.router.drain(rid)
+    events_mod.emit("rollout_step", replica=rid, stage="drain",
+                    version=version)
     try:
         fleet.router.wait_idle(rid, timeout=drain_timeout_s)
         handle = fleet.supervisor.handle_of(rid)
         if handle is None:
             raise RuntimeError(f"replica {rid} vanished mid-rollout")
         handle.update_version(version)
+        events_mod.emit("rollout_step", replica=rid, stage="cutover",
+                        version=version)
     finally:
         fleet.router.undrain(rid)
+        events_mod.emit("rollout_step", replica=rid, stage="undrain",
+                        version=version)
 
 
 def rolling_update(fleet, version: str, *,
@@ -92,6 +100,8 @@ def rolling_update(fleet, version: str, *,
                     failed.append(done)
                     continue
                 try:
+                    events_mod.emit("rollout_step", replica=done,
+                                    stage="rollback", version=previous)
                     _cutover(fleet, done, previous,
                              drain_timeout_s=drain_timeout_s)
                     rolled_back.append(done)

@@ -40,17 +40,41 @@ the router guarantees.
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from perceiver_tpu.fleet.rpc import RpcError
-from perceiver_tpu.resilience.breaker import CLOSED, OPEN, CircuitBreaker
+from perceiver_tpu.obs import events as events_mod
+from perceiver_tpu.obs import trace as trace_mod
+from perceiver_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
 from perceiver_tpu.serving.errors import Unavailable
 from perceiver_tpu.serving.metrics import MetricsRegistry
 
 _HEALTH_RANK = {"READY": 0, "DEGRADED": 1, "STARTING": 2,
                 "UNAVAILABLE": 3}
+
+_BREAKER_STATE_VALUES = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+def _accepts_trace(handle) -> bool:
+    """Does ``handle.dispatch`` take a ``trace`` kwarg?  Sniffed once
+    at ``add()`` so plain fakes with ``dispatch(arrays)`` keep working
+    and the hot path never inspects signatures."""
+    try:
+        sig = inspect.signature(handle.dispatch)
+    except (TypeError, ValueError):
+        return False
+    for p in sig.parameters.values():
+        if p.name == "trace" or p.kind is inspect.Parameter.VAR_KEYWORD:
+            return True
+    return False
 
 
 class _ReplicaState:
@@ -63,6 +87,7 @@ class _ReplicaState:
         self.inflight = 0
         self.draining = False
         self.health = "READY"
+        self.accepts_trace = _accepts_trace(handle)
 
 
 class Router:
@@ -103,8 +128,14 @@ class Router:
         self._m_ejected = m.counter(
             "fleet_ejections_total",
             "replica ejections (router breaker opened)")
+        self._m_readmitted = m.counter(
+            "fleet_readmissions_total",
+            "ejected replicas readmitted (router breaker re-closed)")
         self._m_inflight = m.gauge(
             "fleet_replica_inflight", "router-side in-flight per replica")
+        self._m_breaker_state = m.gauge(
+            "fleet_breaker_state",
+            "per-replica router breaker: 0=closed 1=half_open 2=open")
         self._closed = threading.Event()
         self._prober: Optional[threading.Thread] = None
         if prober_interval_s:
@@ -120,20 +151,30 @@ class Router:
             failure_threshold=self._breaker_failure_threshold,
             reset_timeout_s=self._breaker_reset_s,
             clock=self._clock,
-            on_transition=lambda old, new: self._on_transition(new))
+            on_transition=lambda old, new, _rid=rid:
+                self._on_transition(_rid, old, new))
         with self._lock:
             self._replicas[rid] = _ReplicaState(rid, handle, breaker)
             self._m_size.set(len(self._replicas))
+        self._m_breaker_state.labels(replica=rid).set(
+            _BREAKER_STATE_VALUES[breaker.state])
 
-    def _on_transition(self, new: str) -> None:
+    def _on_transition(self, rid: str, old: str, new: str) -> None:
+        self._m_breaker_state.labels(replica=rid).set(
+            _BREAKER_STATE_VALUES.get(new, 0.0))
         if new == OPEN:
             self._m_ejected.inc()
+            events_mod.emit("fleet_ejection", replica=rid)
+        elif new == CLOSED and old != CLOSED:
+            self._m_readmitted.inc()
+            events_mod.emit("fleet_readmission", replica=rid)
 
     def remove(self, rid: str) -> None:
         with self._lock:
             self._replicas.pop(rid, None)
             self._m_size.set(len(self._replicas))
         self._m_inflight.labels(replica=rid).remove()
+        self._m_breaker_state.labels(replica=rid).remove()
 
     def replicas(self) -> List[str]:
         with self._lock:
@@ -202,10 +243,26 @@ class Router:
 
     def submit(self, arrays: dict) -> dict:
         """Dispatch one request; returns the replica's materialized
-        outputs dict. Raises only typed serving errors."""
+        outputs dict. Raises only typed serving errors.
+
+        Tracing: requests arriving through a batcher carry attached
+        trace contexts; a bare ``submit`` starts its own.  The router
+        records ``route``/``rpc_hop``/``retry`` spans, ships the wire
+        envelope to trace-capable replicas, absorbs the replica-side
+        spans from the reply, and stamps ``reply["trace_id"]`` — so a
+        request killed mid-flight and retried on a sibling yields ONE
+        trace with the failed hop and the retry visible.
+        """
+        ctxs = trace_mod.attached()
+        if not ctxs:
+            own = trace_mod.start_trace(origin="router")
+            if own is not None:
+                ctxs = (own,)
+        wire = ctxs[0].wire() if ctxs else None
         exclude: set = set()
         last_unavailable: Optional[Unavailable] = None
         for attempt in range(self.max_attempts):
+            pick_start = time.monotonic()
             state = self._pick(exclude)
             if state is None:
                 if attempt + 1 >= self.max_attempts:
@@ -216,14 +273,29 @@ class Router:
                 self._sleep(self.retry_backoff_s * (attempt + 1))
                 exclude.clear()
                 continue
+            for c in ctxs:
+                c.record("route", start=pick_start, replica=state.rid,
+                         attempt=attempt)
+            hop_start = time.monotonic()
             try:
-                reply = state.handle.dispatch(arrays)
+                if wire is not None and state.accepts_trace:
+                    reply = state.handle.dispatch(arrays, trace=wire)
+                else:
+                    reply = state.handle.dispatch(arrays)
             except RpcError:
                 self._release(state)
                 state.breaker.record_failure()
                 exclude.add(state.rid)
                 self._m_retries.labels(cause="transport").inc()
+                for c in ctxs:
+                    c.record("rpc_hop", start=hop_start,
+                             replica=state.rid, ok=False,
+                             error="transport")
+                retry_start = time.monotonic()
                 self._sleep(self.retry_backoff_s * (attempt + 1))
+                for c in ctxs:
+                    c.record("retry", start=retry_start,
+                             cause="transport", attempt=attempt)
                 continue
             except Unavailable as e:
                 self._release(state)
@@ -232,6 +304,12 @@ class Router:
                 last_unavailable = e
                 exclude.add(state.rid)
                 self._m_retries.labels(cause="unavailable").inc()
+                for c in ctxs:
+                    c.record("rpc_hop", start=hop_start,
+                             replica=state.rid, ok=False,
+                             error="unavailable")
+                    c.record("retry", cause="unavailable",
+                             attempt=attempt)
                 continue
             except Exception:
                 self._release(state)
@@ -240,7 +318,17 @@ class Router:
                 raise
             self._release(state)
             state.breaker.record_success()
-            state.health = reply.get("health", state.health)
+            for c in ctxs:
+                c.record("rpc_hop", start=hop_start,
+                         replica=state.rid, ok=True)
+            if isinstance(reply, dict):
+                spans = reply.pop("spans", None)
+                if spans:
+                    for c in ctxs:
+                        c.absorb(spans, replica=state.rid)
+                if ctxs:
+                    reply.setdefault("trace_id", ctxs[0].trace_id)
+                state.health = reply.get("health", state.health)
             self._m_requests.labels(outcome="ok").inc()
             return reply
         self._m_requests.labels(outcome="unavailable").inc()

@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional
 
 from perceiver_tpu.fleet.router import Router
 from perceiver_tpu.fleet.rpc import RpcClient, RpcError
+from perceiver_tpu.obs import events as events_mod
 
 _REPLICA_MODULE = "perceiver_tpu.fleet.replica"
 
@@ -46,7 +47,11 @@ class RpcReplicaHandle:
                                  connect_timeout=control_timeout_s)
         self._control_timeout = control_timeout_s
 
-    def dispatch(self, arrays: dict) -> dict:
+    def dispatch(self, arrays: dict,
+                 trace: Optional[dict] = None) -> dict:
+        if trace is not None:
+            return self._client.call("dispatch", arrays=arrays,
+                                     trace=trace)
         return self._client.call("dispatch", arrays=arrays)
 
     def status(self) -> dict:
@@ -247,6 +252,8 @@ class Supervisor:
         with self._lock:
             self._procs.pop(rid, None)
             restarts = self._restarts.get(rid, 0)
+        events_mod.emit("replica_death", replica=rid, restarts=restarts)
+        with self._lock:
             if restarts >= self.max_restarts:
                 self._poisoned.add(rid)
                 return
@@ -261,6 +268,7 @@ class Supervisor:
         with self._lock:
             self._procs[rid] = replacement
         self._on_change(rid, replacement.handle)
+        events_mod.emit("replica_respawn", replica=rid)
 
     @property
     def poisoned(self) -> List[str]:
@@ -306,8 +314,47 @@ class Fleet:
         self.autoscaler = autoscaler
         if self.autoscaler is not None:
             self.autoscaler.bind(self)
+        self.obs = None
+        self._aggregator = None
         for _ in range(replicas):
             self.supervisor.spawn()
+
+    def start_obs(self, *, port: int = 0,
+                  profile_dir: Optional[str] = None):
+        """Start the fleet's observability endpoint: aggregated
+        ``/metrics`` (every replica's registry under a ``replica``
+        label + the router's own series), ``/healthz``, ``/traces/<id>``
+        from the process trace buffer, and ``/profile?seconds=N`` when
+        a ``profile_dir`` is given.  Returns the
+        :class:`~perceiver_tpu.obs.server.ObsServer` (also kept on
+        ``self.obs`` and closed with the fleet)."""
+        from perceiver_tpu.obs.aggregate import FleetAggregator
+        from perceiver_tpu.obs.server import ObsServer
+
+        if self.obs is not None:
+            return self.obs
+        self._aggregator = FleetAggregator(self)
+
+        def health() -> dict:
+            statuses = self.statuses()
+            ready = [rid for rid, s in statuses.items()
+                     if s.get("ready")]
+            return {"ok": bool(ready), "replicas": sorted(statuses),
+                    "ready": sorted(ready)}
+
+        self.obs = ObsServer(metrics_fn=self._aggregator.render,
+                             health_fn=health, port=port,
+                             profile_dir=profile_dir)
+        return self.obs
+
+    def metrics_text(self) -> str:
+        """One aggregated exposition (replica-labeled + router series),
+        without needing the HTTP endpoint up."""
+        from perceiver_tpu.obs.aggregate import FleetAggregator
+
+        if self._aggregator is None:
+            self._aggregator = FleetAggregator(self)
+        return self._aggregator.render()
 
     def _membership_change(self, rid: str, handle) -> None:
         if handle is None:
@@ -349,5 +396,8 @@ class Fleet:
         return out
 
     def close(self) -> None:
+        if self.obs is not None:
+            self.obs.close()
+            self.obs = None
         self.supervisor.close()
         self.router.close()

@@ -1,0 +1,55 @@
+"""One observability plane: request tracing, typed events, fleet
+metrics aggregation, training telemetry, on-demand profiling.
+
+See docs/OBSERVABILITY.md for the schemas, the endpoint map, and the
+overhead budget.  Everything here is host-side and dependency-free:
+tracing and events never touch jax, so they can never change an XLA
+cache key or add a compile (the same contract as
+``resilience/faults.py`` unarmed).
+"""
+
+from perceiver_tpu.obs.events import (
+    SCHEMA,
+    EventLog,
+    default_log,
+    emit,
+    set_default_log,
+    validate_event,
+)
+from perceiver_tpu.obs.trace import (
+    PHASES,
+    SpanCollector,
+    TraceBuffer,
+    TraceContext,
+    attach,
+    attached,
+    default_buffer,
+    enabled,
+    from_wire,
+    region,
+    set_default_buffer,
+    set_enabled,
+    start_trace,
+)
+
+__all__ = [
+    "PHASES",
+    "SCHEMA",
+    "EventLog",
+    "SpanCollector",
+    "TraceBuffer",
+    "TraceContext",
+    "attach",
+    "attached",
+    "default_buffer",
+    "default_log",
+    "emit",
+    "enabled",
+    "from_wire",
+    "region",
+    "set_default_buffer",
+    "set_default_log",
+    "set_enabled",
+    "start_trace",
+    "validate_event",
+]

@@ -1,0 +1,116 @@
+"""Fleet-wide metrics aggregation.
+
+Each replica already serves its engine's full registry over the
+``metrics`` RPC op; the aggregator scrapes every live replica, parses
+the expositions, and re-emits ONE exposition in which every replica
+series carries a ``replica`` label — plus the router's own registry
+(per-replica breaker state, retries, queue depths) appended verbatim,
+since ``fleet_*`` names never collide with ``serving_*`` names.
+
+A replica that fails its scrape (mid-restart, mid-kill) is skipped and
+surfaced as ``fleet_scrape_errors_total`` rather than failing the
+whole endpoint: the metrics plane must degrade, not flap, under
+exactly the chaos it exists to observe.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from perceiver_tpu.obs import promparse
+from perceiver_tpu.serving.metrics import escape_label_value
+
+__all__ = ["merge_expositions", "FleetAggregator"]
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    out = repr(float(v))
+    return out[:-2] if out.endswith(".0") else out
+
+
+def _fmt_sample(sample: promparse.Sample,
+                extra: Optional[Tuple[str, str]] = None) -> str:
+    labels = dict(sample.labels)
+    if extra is not None:
+        labels[extra[0]] = extra[1]
+    if labels:
+        inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in sorted(labels.items()))
+        return f"{sample.name}{{{inner}}} {_fmt_value(sample.value)}"
+    return f"{sample.name} {_fmt_value(sample.value)}"
+
+
+def merge_expositions(per_source: Dict[str, str],
+                      label: str = "replica",
+                      extra_texts: Sequence[str] = ()) -> str:
+    """Merge ``{source_id: exposition_text}`` into one exposition where
+    every sample gains ``label="<source_id>"``; ``extra_texts`` (e.g.
+    the router's own registry render) are appended with no relabeling.
+
+    Raises :class:`promparse.ParseError` if any input is malformed —
+    callers scrape our own emitter, so malformed input is a bug.
+    """
+    families: Dict[str, promparse.Family] = {}
+    rendered: Dict[str, List[str]] = {}
+    for source in sorted(per_source):
+        for fam in promparse.parse(per_source[source]).values():
+            known = families.get(fam.name)
+            if known is None:
+                families[fam.name] = fam
+                rendered[fam.name] = []
+            elif known.kind != fam.kind:
+                raise promparse.ParseError(
+                    f"{fam.name}: kind mismatch across sources "
+                    f"({known.kind} vs {fam.kind})")
+            rendered[fam.name].extend(
+                _fmt_sample(s, (label, source)) for s in fam.samples)
+    lines: List[str] = []
+    for name in sorted(families):
+        fam = families[name]
+        if fam.help:
+            lines.append(f"# HELP {name} {fam.help}")
+        lines.append(f"# TYPE {name} {fam.kind}")
+        lines.extend(rendered[name])
+    for text in extra_texts:
+        stripped = text.strip("\n")
+        if stripped:
+            lines.append(stripped)
+    return "\n".join(lines) + "\n"
+
+
+class FleetAggregator:
+    """Scrape-and-merge view over a live :class:`fleet.supervisor.
+    Fleet` — the callable behind the obs server's ``/metrics``."""
+
+    def __init__(self, fleet) -> None:
+        self._fleet = fleet
+        m = fleet.router.metrics
+        self._m_scrape_errors = m.counter(
+            "fleet_scrape_errors_total",
+            "replica metric scrapes that failed, by replica")
+
+    def scrape(self) -> Dict[str, str]:
+        """Per-replica exposition text, skipping unreachable replicas."""
+        from perceiver_tpu.fleet.rpc import RpcError
+
+        out: Dict[str, str] = {}
+        for rid in self._fleet.supervisor.replicas():
+            handle = self._fleet.supervisor.handle_of(rid)
+            if handle is None:
+                continue
+            try:
+                out[rid] = handle.metrics_text()
+            except (RpcError, OSError):
+                # a dying replica must not take /metrics down with it
+                self._m_scrape_errors.labels(replica=rid).inc()
+        return out
+
+    def render(self) -> str:
+        return merge_expositions(
+            self.scrape(),
+            extra_texts=(self._fleet.router.metrics.render(),))

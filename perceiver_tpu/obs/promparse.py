@@ -1,0 +1,236 @@
+"""Prometheus text-exposition (0.0.4) parser and conformance checker.
+
+One parser shared by three consumers: the fleet aggregator re-emits
+scraped replica registries with an injected ``replica`` label, the
+conformance tests assert every registry's output is machine-parseable,
+and ``scripts/obs_check.py`` validates the aggregated endpoint.
+
+The checker enforces the invariants our own emitter promises:
+
+* every sample belongs to a ``# TYPE``-declared metric family, and any
+  ``# HELP`` line pairs with that family's ``# TYPE``;
+* histogram ``_bucket`` series are cumulative (monotone in ``le``) and
+  the ``+Inf`` bucket equals ``_count``;
+* label syntax round-trips (escaped ``\\``, ``\"``, ``\\n``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from perceiver_tpu.serving.metrics import unescape_label_value
+
+__all__ = ["Sample", "Family", "parse", "check_exposition",
+           "ParseError"]
+
+
+class ParseError(ValueError):
+    pass
+
+
+class Sample:
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: Dict[str, str], value: float):
+        self.name = name
+        self.labels = labels
+        self.value = value
+
+    def __repr__(self):
+        return f"Sample({self.name!r}, {self.labels!r}, {self.value!r})"
+
+
+class Family:
+    """One metric family: TYPE, optional HELP, and its samples.
+
+    Histogram families own their ``_bucket``/``_sum``/``_count``
+    samples under the base name.
+    """
+
+    __slots__ = ("name", "kind", "help", "samples")
+
+    def __init__(self, name: str, kind: str, help: Optional[str] = None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.samples: List[Sample] = []
+
+
+def _parse_labels(text: str, where: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    i = 0
+    n = len(text)
+    while i < n:
+        eq = text.find("=", i)
+        if eq < 0:
+            raise ParseError(f"{where}: missing '=' in labels {text!r}")
+        key = text[i:eq].strip().lstrip(",").strip()
+        if not key:
+            raise ParseError(f"{where}: empty label name in {text!r}")
+        if eq + 1 >= n or text[eq + 1] != '"':
+            raise ParseError(f"{where}: unquoted label value in {text!r}")
+        # scan for the closing quote, honouring backslash escapes
+        j = eq + 2
+        raw = []
+        while j < n:
+            ch = text[j]
+            if ch == "\\" and j + 1 < n:
+                raw.append(text[j:j + 2])
+                j += 2
+                continue
+            if ch == '"':
+                break
+            raw.append(ch)
+            j += 1
+        else:
+            raise ParseError(f"{where}: unterminated label value "
+                             f"in {text!r}")
+        labels[key] = unescape_label_value("".join(raw))
+        i = j + 1
+    return labels
+
+
+def _parse_value(text: str, where: str) -> float:
+    text = text.strip()
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    try:
+        return float(text)
+    except ValueError:
+        raise ParseError(f"{where}: bad sample value {text!r}")
+
+
+def _family_name(sample_name: str, families: Dict[str, Family]) -> str:
+    """Map a sample name to its declaring family (histogram suffix
+    stripping)."""
+    if sample_name in families:
+        return sample_name
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix):
+            base = sample_name[: -len(suffix)]
+            if base in families:
+                return base
+    return sample_name
+
+
+def parse(text: str) -> Dict[str, Family]:
+    """Parse exposition text into ``{family_name: Family}``.
+
+    Raises :class:`ParseError` on syntactically invalid input.  Samples
+    with no preceding ``# TYPE`` get an ``untyped`` family (legal in
+    the wild, flagged later by :func:`check_exposition` because our
+    emitter always declares types).
+    """
+    families: Dict[str, Family] = {}
+    pending_help: Dict[str, str] = {}
+    for ln, line in enumerate(text.splitlines(), start=1):
+        where = f"line {ln}"
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                name = parts[2]
+                kind = parts[3] if len(parts) > 3 else ""
+                if kind not in ("counter", "gauge", "histogram",
+                                "summary", "untyped"):
+                    raise ParseError(f"{where}: bad TYPE {kind!r}")
+                if name in families:
+                    raise ParseError(f"{where}: duplicate TYPE for "
+                                     f"{name!r}")
+                families[name] = Family(name, kind,
+                                        pending_help.pop(name, None))
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                name = parts[2]
+                pending_help[name] = parts[3] if len(parts) > 3 else ""
+            # other comments ignored
+            continue
+        # sample line: name[{labels}] value
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                raise ParseError(f"{where}: unbalanced braces in "
+                                 f"{line!r}")
+            name = line[:brace]
+            labels = _parse_labels(line[brace + 1:close], where)
+            value = _parse_value(line[close + 1:], where)
+        else:
+            fields = line.split(None, 1)
+            if len(fields) != 2:
+                raise ParseError(f"{where}: malformed sample {line!r}")
+            name, labels = fields[0], {}
+            value = _parse_value(fields[1], where)
+        fam_name = _family_name(name, families)
+        fam = families.get(fam_name)
+        if fam is None:
+            fam = Family(fam_name, "untyped",
+                         pending_help.pop(fam_name, None))
+            families[fam_name] = fam
+        fam.samples.append(Sample(name, labels, value))
+    # HELP with no TYPE and no samples: record as orphan untyped family
+    for name, help_text in pending_help.items():
+        families.setdefault(name, Family(name, "untyped", help_text))
+    return families
+
+
+def check_exposition(text: str) -> List[str]:
+    """Return conformance problems (empty list == clean).
+
+    Beyond parseability: no untyped families, HELP (when present)
+    pairs with its TYPE, histogram buckets are cumulative and end in a
+    ``+Inf`` bucket equal to ``_count``.
+    """
+    try:
+        families = parse(text)
+    except ParseError as e:
+        return [str(e)]
+    problems: List[str] = []
+    for fam in families.values():
+        if fam.kind == "untyped":
+            problems.append(f"{fam.name}: samples without a # TYPE "
+                            "declaration")
+            continue
+        if fam.kind != "histogram":
+            continue
+        # group bucket samples by their non-le label set so labeled
+        # histograms (none today, but the parser shouldn't assume)
+        # are checked per-series
+        series: Dict[Tuple, List[Tuple[float, float]]] = {}
+        counts: Dict[Tuple, float] = {}
+        for s in fam.samples:
+            base = tuple(sorted((k, v) for k, v in s.labels.items()
+                                if k != "le"))
+            if s.name == fam.name + "_bucket":
+                le = s.labels.get("le")
+                if le is None:
+                    problems.append(f"{fam.name}: bucket sample "
+                                    "missing 'le' label")
+                    continue
+                bound = math.inf if le == "+Inf" else float(le)
+                series.setdefault(base, []).append((bound, s.value))
+            elif s.name == fam.name + "_count":
+                counts[base] = s.value
+        for base, buckets in series.items():
+            buckets.sort(key=lambda bv: bv[0])
+            cum = -1.0
+            for bound, v in buckets:
+                if v < cum:
+                    problems.append(
+                        f"{fam.name}: bucket counts not cumulative at "
+                        f"le={bound}")
+                cum = v
+            if not buckets or buckets[-1][0] != math.inf:
+                problems.append(f"{fam.name}: missing +Inf bucket")
+            elif base in counts and buckets[-1][1] != counts[base]:
+                problems.append(
+                    f"{fam.name}: +Inf bucket ({buckets[-1][1]}) != "
+                    f"_count ({counts[base]})")
+            if base not in counts:
+                problems.append(f"{fam.name}: histogram without a "
+                                "_count sample")
+    return problems

@@ -1,0 +1,292 @@
+"""Request tracing: dependency-free trace contexts and span buffers.
+
+One request, one ``trace_id``, many *spans* — each span is a typed
+phase (``queue_wait``, ``batch_form``, ``pad_or_pack``, ``dispatch``,
+``device``, ``rpc_hop``, ``retry``, ``route``) with a monotonic-clock
+start/end measured in the process that did the work.  The context is
+created where the request enters the system (``api.submit`` /
+``Fleet.submit``), rides the fleet RPC envelope as a tiny wire dict
+(``{"trace_id", "parent_id"}``), and the replica ships its locally
+collected spans back in the dispatch reply so the router can absorb
+them into one trace.  A retried request therefore yields a SINGLE
+trace with the failed hop, the ``retry`` span, and the sibling's
+server-side spans all visible.
+
+Everything here is host-side Python: no jax imports, no device work,
+so tracing can never change an XLA cache key or add a compile.  When
+tracing is disabled (``set_enabled(False)``), ``start_trace`` returns
+``None`` and the hot-path cost of an instrumented call site collapses
+to one thread-local attribute read.
+
+Clock caveat: span ``start``/``end`` are ``time.monotonic`` values and
+are only comparable *within* one process.  Cross-process ordering uses
+the spans' ``wall`` field (coarse ``time.time``), durations are always
+trustworthy.
+
+This is *request* tracing; for XLA profiler traces (the other kind of
+"trace") see ``scripts/trace_analysis.py`` and the ``/profile``
+endpoint in :mod:`perceiver_tpu.obs.server`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "PHASES",
+    "TraceContext",
+    "TraceBuffer",
+    "SpanCollector",
+    "start_trace",
+    "from_wire",
+    "attach",
+    "attached",
+    "region",
+    "enabled",
+    "set_enabled",
+    "default_buffer",
+    "set_default_buffer",
+]
+
+#: The typed phase vocabulary.  ``record``/``region`` reject anything
+#: else so dashboards and tests can rely on a closed set.
+PHASES = (
+    "submit",       # client-side: request accepted into the system
+    "queue_wait",   # batcher: enqueue -> popped into a batch
+    "batch_form",   # batcher: popped -> batch handed to the runner
+    "pad_or_pack",  # engine: host-side bucket padding / packing
+    "dispatch",     # engine: executable launch (async, host cost only)
+    "device",       # api: materialize (the deliberate device sync)
+    "route",        # router: replica selection
+    "rpc_hop",      # router: one RPC attempt against one replica
+    "retry",        # router: backoff + re-pick after a failed hop
+)
+
+_enabled = True
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(flag: bool) -> None:
+    """Process-wide tracing switch (used by the overhead gate tests)."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+class SpanCollector:
+    """A plain list sink for spans (replica side, per request).
+
+    Replicas don't keep traces — they collect the spans a request
+    produced locally and return them in the dispatch reply.
+    """
+
+    def __init__(self) -> None:
+        self.spans: List[dict] = []
+
+    def add(self, trace_id: str, span: dict) -> None:
+        self.spans.append(span)
+
+
+class TraceBuffer:
+    """Bounded in-memory ring of traces (LRU-evicting, thread-safe)."""
+
+    def __init__(self, max_traces: int = 256,
+                 max_spans_per_trace: int = 128) -> None:
+        self.max_traces = int(max_traces)
+        self.max_spans_per_trace = int(max_spans_per_trace)
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, List[dict]]" = OrderedDict()
+        self.dropped_spans = 0
+
+    def add(self, trace_id: str, span: dict) -> None:
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if spans is None:
+                spans = []
+                self._traces[trace_id] = spans
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+            else:
+                self._traces.move_to_end(trace_id)
+            if len(spans) < self.max_spans_per_trace:
+                spans.append(span)
+            else:
+                self.dropped_spans += 1
+
+    def absorb(self, trace_id: str, spans: Iterable[dict],
+               **extra_attrs) -> None:
+        """Merge remotely collected spans into a trace, optionally
+        tagging each with extra attrs (e.g. ``replica="r0"``)."""
+        for span in spans:
+            if extra_attrs:
+                span = dict(span)
+                attrs = dict(span.get("attrs") or {})
+                attrs.update(extra_attrs)
+                span["attrs"] = attrs
+            self.add(trace_id, span)
+
+    def get(self, trace_id: str) -> Optional[List[dict]]:
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            return list(spans) if spans is not None else None
+
+    def trace_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._traces)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+_default_buffer = TraceBuffer()
+
+
+def default_buffer() -> TraceBuffer:
+    return _default_buffer
+
+
+def set_default_buffer(buffer: TraceBuffer) -> TraceBuffer:
+    global _default_buffer
+    prev = _default_buffer
+    _default_buffer = buffer
+    return prev
+
+
+class TraceContext:
+    """One request's trace handle.
+
+    Spans are recorded *retrospectively*: the caller measures with
+    whatever clocks it already has (``enqueued_at``, ``taken_at``) and
+    calls :meth:`record` with explicit bounds, or uses the
+    :func:`region` context manager for the simple wrap case.
+    """
+
+    __slots__ = ("trace_id", "parent_id", "origin", "_sink")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 parent_id: Optional[str] = None,
+                 sink=None, origin: str = "") -> None:
+        self.trace_id = trace_id or _new_id()
+        self.parent_id = parent_id
+        self.origin = origin
+        self._sink = sink if sink is not None else _default_buffer
+
+    def record(self, phase: str, *, start: Optional[float] = None,
+               end: Optional[float] = None,
+               duration_s: Optional[float] = None, **attrs) -> dict:
+        if phase not in PHASES:
+            raise ValueError(
+                f"unknown trace phase {phase!r}; expected one of {PHASES}")
+        if end is None:
+            end = time.monotonic()
+        if start is None:
+            start = end - duration_s if duration_s is not None else end
+        span = {
+            "trace_id": self.trace_id,
+            "phase": phase,
+            "start": start,
+            "end": end,
+            "duration_s": round(end - start, 9),
+            "wall": time.time(),
+            "pid": os.getpid(),
+        }
+        if self.origin:
+            span["origin"] = self.origin
+        if attrs:
+            span["attrs"] = attrs
+        self._sink.add(self.trace_id, span)
+        return span
+
+    def wire(self) -> Dict[str, str]:
+        """The cross-process envelope: small, picklable, stable."""
+        out = {"trace_id": self.trace_id}
+        if self.parent_id:
+            out["parent_id"] = self.parent_id
+        return out
+
+    def absorb(self, spans: Iterable[dict], **extra_attrs) -> None:
+        """Merge spans collected in another process into this trace
+        (re-keyed to this ``trace_id``, optionally tagged — the router
+        tags replica-side spans with the replica id)."""
+        for span in spans:
+            span = dict(span)
+            span["trace_id"] = self.trace_id
+            if extra_attrs:
+                attrs = dict(span.get("attrs") or {})
+                attrs.update(extra_attrs)
+                span["attrs"] = attrs
+            self._sink.add(self.trace_id, span)
+
+
+def start_trace(origin: str = "",
+                sink=None) -> Optional[TraceContext]:
+    """Create a trace for a new request, or ``None`` when disabled.
+
+    Call sites hold the possibly-``None`` context and guard with
+    ``if ctx is not None`` — the disabled path does no allocation.
+    """
+    if not _enabled:
+        return None
+    return TraceContext(sink=sink, origin=origin)
+
+
+def from_wire(wire: Optional[dict], sink=None,
+              origin: str = "") -> Optional[TraceContext]:
+    """Rehydrate a context from the RPC envelope dict (replica side)."""
+    if not _enabled or not wire or "trace_id" not in wire:
+        return None
+    return TraceContext(trace_id=str(wire["trace_id"]),
+                        parent_id=wire.get("parent_id"),
+                        sink=sink, origin=origin)
+
+
+# --- thread-local attachment ------------------------------------------------
+# The engine runs one *batch* containing many requests; spans recorded
+# inside the batcher's runner call must land in every member trace.
+# ``attach`` binds the member contexts to the current thread, ``region``
+# records one measured span into each.  Unattached regions are no-ops.
+
+_tls = threading.local()
+
+
+def attached() -> Tuple[TraceContext, ...]:
+    return getattr(_tls, "ctxs", ())
+
+
+@contextlib.contextmanager
+def attach(ctxs: Sequence[Optional[TraceContext]]):
+    prev = getattr(_tls, "ctxs", ())
+    _tls.ctxs = tuple(c for c in ctxs if c is not None)
+    try:
+        yield
+    finally:
+        _tls.ctxs = prev
+
+
+@contextlib.contextmanager
+def region(phase: str, **attrs):
+    """Record ``phase`` over the wrapped block into every attached
+    trace.  Cost when nothing is attached: one getattr + tuple check."""
+    ctxs = getattr(_tls, "ctxs", ())
+    if not ctxs:
+        yield
+        return
+    start = time.monotonic()
+    try:
+        yield
+    finally:
+        end = time.monotonic()
+        for c in ctxs:
+            c.record(phase, start=start, end=end, **attrs)

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from perceiver_tpu.obs import events as events_mod
+
 OFF = "off"
 HALT = "halt"
 SKIP = "skip"
@@ -133,6 +135,7 @@ class StepGuard:
             if self.policy == HALT:
                 raise NonFiniteLossError(step)
             self.skipped_total += 1
+            events_mod.emit("guard_skip", step=step)
             self._streak += 1
             if self._streak >= self.streak_to_rewind:
                 if self.rewinds >= self.max_rewinds:
@@ -143,5 +146,6 @@ class StepGuard:
                                "rewind budget exhausted")
                 self.rewinds += 1
                 self._streak = 0
+                events_mod.emit("guard_rewind", step=step)
                 return REWIND
         return OK

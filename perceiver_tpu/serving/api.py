@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from perceiver_tpu.obs import trace as trace_mod
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
 from perceiver_tpu.serving.batcher import (
     MicroBatcher,
@@ -223,7 +224,9 @@ class MLMServer(_Server):
             {"input_ids": ids.astype(np.int32, copy=False),
              "pad_mask": pad_mask},
             lengths=lengths)
-        out = materialize(res, self.engine.graph)
+        # "device" = the one deliberate sync of the serving path
+        with trace_mod.region("device"):
+            out = materialize(res, self.engine.graph)
         results = []
         for i, text in enumerate(texts):
             n = int(lengths[i])
@@ -237,7 +240,8 @@ class MLMServer(_Server):
         res = self.engine.dispatch_packed(
             {"packed_ids": packed, "row_offsets": offsets,
              "lengths": lengths})
-        out = materialize_packed(res, self.engine.packed_graph)
+        with trace_mod.region("device"):
+            out = materialize_packed(res, self.engine.packed_graph)
         results = []
         for i, (text, row_ids, n) in enumerate(payloads):
             s = int(offsets[i])
@@ -302,7 +306,8 @@ class TextClassifierServer(_Server):
             res = self.engine.dispatch_packed(
                 {"packed_ids": packed, "row_offsets": offsets,
                  "lengths": lengths})
-            out = materialize_packed(res, self.engine.packed_graph)
+            with trace_mod.region("device"):
+                out = materialize_packed(res, self.engine.packed_graph)
             n = len(payloads)
         else:
             texts = payloads
@@ -315,7 +320,8 @@ class TextClassifierServer(_Server):
                 {"input_ids": ids.astype(np.int32, copy=False),
                  "pad_mask": pad_mask},
                 lengths=lengths)
-            out = materialize(res, self.engine.graph)
+            with trace_mod.region("device"):
+                out = materialize(res, self.engine.graph)
             n = len(texts)
         return [Classification(label=int(out["label"][i]),
                                probs=out["probs"][i],
@@ -337,7 +343,8 @@ class ImageClassifierServer(_Server):
     def _run_batch(self, images: List[np.ndarray]) -> List[Classification]:
         stacked = np.stack(images).astype(np.float32, copy=False)
         res = self.engine.dispatch({"image": stacked})
-        out = materialize(res, self.engine.graph)
+        with trace_mod.region("device"):
+            out = materialize(res, self.engine.graph)
         return [Classification(label=int(out["label"][i]),
                                probs=out["probs"][i],
                                logits=out["logits"][i])
@@ -364,7 +371,8 @@ class SegmentationServer(_Server):
     def _run_batch(self, images: List[np.ndarray]) -> List[SegmentationMap]:
         stacked = np.stack(images).astype(np.float32, copy=False)
         res = self.engine.dispatch({"image": stacked})
-        out = materialize(res, self.engine.graph)
+        with trace_mod.region("device"):
+            out = materialize(res, self.engine.graph)
         return [SegmentationMap(classes=out["classes"][i],
                                 confidence=out["confidence"][i])
                 for i in range(len(images))]

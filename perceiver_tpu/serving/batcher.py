@@ -37,6 +37,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
 
+from perceiver_tpu.obs import trace as trace_mod
 from perceiver_tpu.serving.errors import BatchError, ServingError, Unavailable
 from perceiver_tpu.serving.metrics import MetricsRegistry
 
@@ -60,6 +61,8 @@ class _Pending:
     future: Future
     enqueued_at: float
     deadline: Optional[float]  # absolute monotonic seconds, or None
+    ctx: Optional[trace_mod.TraceContext] = None
+    taken_at: float = 0.0  # stamped when popped into a batch
 
 
 class MicroBatcher:
@@ -115,13 +118,21 @@ class MicroBatcher:
 
     # -- client side ------------------------------------------------------
 
-    def submit(self, payload, *, timeout_ms: Optional[float] = None
-               ) -> Future:
+    def submit(self, payload, *, timeout_ms: Optional[float] = None,
+               trace: Optional[trace_mod.TraceContext] = None) -> Future:
         """Enqueue one request. The future resolves to the runner's
         result for it, an ``Overloaded``, or raises the runner's error.
+
+        Each accepted request gets a trace context (the caller's, or a
+        fresh one when tracing is enabled), exposed on the returned
+        future as ``fut.trace_ctx`` so callers can look up their spans
+        by ``trace_ctx.trace_id``.
         """
         now = self._clock()
         fut = Future()
+        ctx = trace if trace is not None \
+            else trace_mod.start_trace(origin="batcher")
+        fut.trace_ctx = ctx
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -133,7 +144,8 @@ class MicroBatcher:
                 return fut
             deadline = (now + timeout_ms / 1000.0
                         if timeout_ms is not None else None)
-            self._queue.append(_Pending(payload, fut, now, deadline))
+            self._queue.append(_Pending(payload, fut, now, deadline,
+                                        ctx=ctx))
             self._m_depth.set(len(self._queue))
             self._not_empty.notify()
         return fut
@@ -192,6 +204,13 @@ class MicroBatcher:
 
     # -- worker side ------------------------------------------------------
 
+    def _pop_taken(self) -> _Pending:
+        """Pop the queue head, stamping when it joined a batch (the
+        queue_wait → batch_form span boundary)."""
+        p = self._queue.popleft()
+        p.taken_at = self._clock()
+        return p
+
     def _take_batch(self) -> Optional[List[_Pending]]:
         """Block for the first request, then gather until ``max_batch``
         or ``max_delay`` past the first. None = closed and drained."""
@@ -200,11 +219,11 @@ class MicroBatcher:
                 self._not_empty.wait(0.1)
             if not self._queue:
                 return None  # closed
-            batch = [self._queue.popleft()]
+            batch = [self._pop_taken()]
             batch_deadline = self._clock() + self.max_delay
             while len(batch) < self.max_batch:
                 if self._queue:
-                    batch.append(self._queue.popleft())
+                    batch.append(self._pop_taken())
                     continue
                 remaining = batch_deadline - self._clock()
                 if remaining <= 0 or self._closed:
@@ -239,8 +258,22 @@ class MicroBatcher:
                 live.append(p)
         if not live:
             return
+        run_start = self._clock()
+        ctxs = [p.ctx for p in live if p.ctx is not None]
+        for p in live:
+            if p.ctx is not None:
+                p.ctx.record("queue_wait", start=p.enqueued_at,
+                             end=p.taken_at)
+                p.ctx.record("batch_form", start=p.taken_at,
+                             end=run_start, batch_size=len(live))
         try:
-            results = self._runner([p.payload for p in live])
+            # attach the member traces so engine/api regions executed
+            # inside the runner attribute to every request in the batch
+            if ctxs:
+                with trace_mod.attach(ctxs):
+                    results = self._runner([p.payload for p in live])
+            else:
+                results = self._runner([p.payload for p in live])
             if len(results) != len(live):
                 raise RuntimeError(
                     f"runner returned {len(results)} results for "
@@ -309,7 +342,7 @@ class TokenBudgetBatcher(MicroBatcher):
                 self._not_empty.wait(0.1)
             if not self._queue:
                 return None  # closed
-            batch = [self._queue.popleft()]
+            batch = [self._pop_taken()]
             spent = self.cost_fn(batch[0].payload)
             batch_deadline = self._clock() + self.max_delay
             while len(batch) < self.max_batch:
@@ -317,7 +350,7 @@ class TokenBudgetBatcher(MicroBatcher):
                     cost = self.cost_fn(self._queue[0].payload)
                     if spent + cost > self.token_budget:
                         break
-                    batch.append(self._queue.popleft())
+                    batch.append(self._pop_taken())
                     spent += cost
                     continue
                 remaining = batch_deadline - self._clock()

@@ -46,6 +46,8 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from perceiver_tpu.cache import ExecutableCache, aot_compile, default_cache
+from perceiver_tpu.obs import events as events_mod
+from perceiver_tpu.obs import trace as trace_mod
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
 from perceiver_tpu.resilience import faults
 from perceiver_tpu.resilience.breaker import (
@@ -386,6 +388,9 @@ class ServingEngine:
                 self._m_exec_misses.inc()
                 self._m_exec_bytes.labels(direction="written").inc(
                     info["bytes"])
+            events_mod.emit("exec_cache",
+                            bucket=self._bucket_name(bucket),
+                            hit=bool(info["hit"]), phase=phase)
         with self._exe_lock:
             # a concurrent compile of the same bucket may have won —
             # keep the first, count only one executable
@@ -449,6 +454,8 @@ class ServingEngine:
 
     def _on_breaker_transition(self, bucket_name: str, old: str,
                                new: str) -> None:
+        events_mod.emit("breaker_transition", bucket=bucket_name,
+                        old=old, new=new)
         self._m_breaker_transitions.labels(bucket=bucket_name,
                                            to=new).inc()
         self._m_breaker_state.labels(bucket=bucket_name).set(
@@ -583,8 +590,13 @@ class ServingEngine:
             raise ValueError(
                 f"lengths has {lengths.shape[0]} entries for {n} rows")
         bucket = self.bucket_for(n, length)
-        outputs = self._guarded_execute(
-            bucket, self._pad_to_bucket(arrays, bucket))
+        # trace regions are host-side wall clocks around host work —
+        # nothing here enters the jitted graph (serving-host-sync)
+        with trace_mod.region("pad_or_pack"):
+            padded = self._pad_to_bucket(arrays, bucket)
+        with trace_mod.region("dispatch",
+                              bucket=self._bucket_name(bucket)):
+            outputs = self._guarded_execute(bucket, padded)
 
         self._m_occupancy.observe(n / bucket[0])
         if self.graph.seq_bucketable:
@@ -672,8 +684,11 @@ class ServingEngine:
                 f"max_seq_len {self.packed_graph.max_seq_len}")
         tokens = arrays["packed_ids"].shape[0]
         bucket = self.packed_bucket_for(tokens, n)
-        outputs = self._guarded_execute(
-            bucket, self._pad_packed(arrays, bucket))
+        with trace_mod.region("pad_or_pack"):
+            padded = self._pad_packed(arrays, bucket)
+        with trace_mod.region("dispatch",
+                              bucket=self._bucket_name(bucket)):
+            outputs = self._guarded_execute(bucket, padded)
 
         _, t_bucket, r_bucket = bucket
         real = int(lengths.sum())

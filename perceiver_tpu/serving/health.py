@@ -28,6 +28,7 @@ from __future__ import annotations
 import enum
 import threading
 
+from perceiver_tpu.obs import events as events_mod
 from perceiver_tpu.serving.metrics import MetricsRegistry
 
 
@@ -76,3 +77,5 @@ class HealthMonitor:
                 else 0)
             self._m_transitions.labels(**{"from": old.name.lower(),
                                           "to": new.name.lower()}).inc()
+        events_mod.emit("health_transition", old=old.name.lower(),
+                        new=new.name.lower())

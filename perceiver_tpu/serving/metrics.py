@@ -28,10 +28,42 @@ DEFAULT_LATENCY_BUCKETS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the exposition format 0.0.4 spec:
+    backslash, double-quote, and line feed — in that order, so the
+    escaping backslashes aren't themselves re-escaped."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def unescape_label_value(value: str) -> str:
+    """Inverse of :func:`escape_label_value` (used by the exposition
+    parser in ``obs/promparse.py`` and the round-trip tests)."""
+    out = []
+    i = 0
+    while i < len(value):
+        ch = value[i]
+        if ch == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:  # unknown escape: keep verbatim, as Prometheus does
+                out.append(ch + nxt)
+            i += 2
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 

@@ -41,6 +41,7 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from perceiver_tpu.obs import events as events_mod
 from perceiver_tpu.resilience import faults
 from perceiver_tpu.training.state import TrainState
 
@@ -99,6 +100,7 @@ def write_manifest(step_dir: str) -> Dict[str, Any]:
         json.dump(manifest, f, indent=1, sort_keys=True)
         f.write("\n")
     os.replace(tmp, os.path.join(step_dir, MANIFEST_NAME))
+    events_mod.emit("checkpoint_seal", path=step_dir)
     return manifest
 
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from perceiver_tpu.obs import events as events_mod
 from perceiver_tpu.ops.policy import Policy
 from perceiver_tpu.resilience import faults
 from perceiver_tpu.resilience import guard as guard_mod
@@ -104,6 +105,14 @@ class TrainerConfig:
     # None/empty = unarmed (zero overhead)
     fault_plan: Optional[str] = None
     profiler: Optional[str] = None
+    # on-demand profiling without a restart: arm SIGUSR1 to toggle a
+    # jax.profiler capture into this directory (obs/telemetry.py;
+    # docs/OBSERVABILITY.md). None = signal profiler not installed.
+    profile_dir: Optional[str] = None
+    # per-step JSONL telemetry + training_* metrics registry
+    # (obs/telemetry.py). Rides the crossed_log host sync — zero extra
+    # device syncs. None = telemetry off.
+    telemetry_dir: Optional[str] = None
     # overlap host batch assembly with device compute: depth of the
     # background prefetch queue (the torch-DataLoader-workers analogue,
     # reference data/imdb.py:112-126; 0 disables)
@@ -266,6 +275,9 @@ class Trainer:
         self._single_step_ran = False
         self._eval_step = None
         self._preempted = False
+        # per-step telemetry sink (obs/telemetry.py), built in _fit()
+        # when cfg.telemetry_dir is set
+        self.telemetry = None
         # persistent compile cache for the AOT first-dispatch path
         # (config dir wins over the PERCEIVER_EXEC_CACHE env default)
         from perceiver_tpu.cache import default_cache
@@ -447,6 +459,9 @@ class Trainer:
             max_to_keep=1, monitor="", hparams=self._hparams())
         hook.save(self.global_step, state, {})
         hook.wait()
+        events_mod.emit("preempt_checkpoint", step=int(self.global_step))
+        if self.telemetry is not None:
+            self.telemetry.preempt_checkpoint(self.global_step)
         print(f"Preemption: saved step {self.global_step} to "
               f"{os.path.join(self.log_dir, 'checkpoints-preempt')}")
         return True
@@ -547,9 +562,19 @@ class Trainer:
                 installed = True
             except ValueError:
                 pass  # not on the main thread
+        uninstall_profiler = None
+        if self.config.profile_dir:
+            from perceiver_tpu.obs.telemetry import install_signal_profiler
+            # SIGUSR1 toggles a jax.profiler capture into profile_dir;
+            # returns None off the main thread (profiling stays manual)
+            uninstall_profiler = install_signal_profiler(
+                self.config.profile_dir,
+                event_log=events_mod.default_log())
         try:
             return self._fit()
         finally:
+            if uninstall_profiler is not None:
+                uninstall_profiler()
             if installed:
                 # old_term is None when the prior handler was installed
                 # at the C level — SIG_DFL is the closest restorable
@@ -584,6 +609,9 @@ class Trainer:
         self.datamodule.setup()
         self.writer = (SummaryWriter(self.log_dir)
                        if jax.process_index() == 0 else _NullWriter())
+        if cfg.telemetry_dir and jax.process_index() == 0:
+            from perceiver_tpu.obs.telemetry import Telemetry
+            self.telemetry = Telemetry(cfg.telemetry_dir)
         if cfg.enable_checkpointing:
             self._ckpt = CheckpointHook(
                 os.path.join(self.log_dir, "checkpoints"),
@@ -794,8 +822,15 @@ class Trainer:
                             [np.asarray(x) for x in losses])
                     else:
                         losses_host = np.asarray(losses)
+                    skips_before = self._guard.skipped_total
                     action = self._guard.observe(losses_host, prev_step)
+                    if self.telemetry is not None:
+                        for _ in range(self._guard.skipped_total
+                                       - skips_before):
+                            self.telemetry.guard_skip(self.global_step)
                     if action == guard_mod.REWIND:
+                        if self.telemetry is not None:
+                            self.telemetry.guard_rewind(self.global_step)
                         state = self._guard_rewind(state)
                         epoch, replay_batches = self._anchor_pos
                         metrics = None
@@ -867,6 +902,16 @@ class Trainer:
                             "guard_skipped_steps",
                             float(self._guard.skipped_total),
                             self.global_step)
+                    if self.telemetry is not None and metrics is not None:
+                        # the fence() above already pulled metrics to
+                        # host — telemetry adds zero device syncs
+                        self.telemetry.step(
+                            self.global_step,
+                            float(metrics.get("loss", float("nan"))),
+                            steps_delta=steps_since,
+                            steps_per_sec=steps_since / max(dt, 1e-9),
+                            samples_per_sec=throughput,
+                            mfu=util if util is not None else 0.0)
                     t0, samples_since, steps_since = time.time(), 0, 0
 
                 if cfg.preempt_checkpoint and \

@@ -167,7 +167,15 @@ def _run_arm(arm: str, args, task, texts, arrivals, *, seq_buckets,
                        max_delay_ms=args.max_delay_ms,
                        max_depth=args.max_depth, packed=packed)
 
+    # per-arm trace buffer sized to the whole trace so the span-derived
+    # phase breakdown below never loses early requests to LRU eviction
+    from perceiver_tpu.obs import trace as trace_mod
+
+    arm_buffer = trace_mod.TraceBuffer(max_traces=len(texts) + 16)
+    prev_buffer = trace_mod.set_default_buffer(arm_buffer)
+
     latencies_ms: list = []
+    trace_ids: list = []
     shed = 0
     errors = 0
     lock = threading.Lock()
@@ -182,11 +190,14 @@ def _run_arm(arm: str, args, task, texts, arrivals, *, seq_buckets,
                 errors += 1
             return
         dt_ms = (time.perf_counter() - t_submit) * 1e3
+        ctx = getattr(fut, "trace_ctx", None)
         with lock:
             if isinstance(result, Overloaded):
                 shed += 1
             else:
                 latencies_ms.append(dt_ms)
+                if ctx is not None:
+                    trace_ids.append(ctx.trace_id)
 
     n = len(texts)
     print(f"[bench_serving] {arm}: offering {n} requests at "
@@ -207,6 +218,24 @@ def _run_arm(arm: str, args, task, texts, arrivals, *, seq_buckets,
             w.join(timeout=120)
         wall = time.perf_counter() - start
         server.close()
+    trace_mod.set_default_buffer(prev_buffer)
+
+    # span-derived per-phase latency: where each served request's time
+    # actually went (queue vs dispatch vs the device materialize sync)
+    phase_ms = {"queue_wait": [], "dispatch": [], "device": []}
+    with lock:
+        for tid in trace_ids:
+            for span in arm_buffer.get(tid) or ():
+                if span["phase"] in phase_ms:
+                    phase_ms[span["phase"]].append(
+                        span["duration_s"] * 1e3)
+
+    def phase_pct(values, p):
+        if not values:
+            return None
+        ranked = sorted(values)
+        return round(ranked[min(int(p / 100 * len(ranked)),
+                                len(ranked) - 1)], 3)
 
     served = len(latencies_ms)
     lat = np.asarray(sorted(latencies_ms)) if served else np.zeros(1)
@@ -248,6 +277,12 @@ def _run_arm(arm: str, args, task, texts, arrivals, *, seq_buckets,
             labels.get("bucket", ""): int(v)
             for labels, v in dispatch.items()
         } if dispatch else {},
+        "phase_breakdown_ms": {
+            phase: {"p50": phase_pct(values, 50),
+                    "p95": phase_pct(values, 95),
+                    "spans": len(values)}
+            for phase, values in phase_ms.items()
+        },
     }
     return detail
 

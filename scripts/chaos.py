@@ -102,8 +102,13 @@ def scenario_nan_skip(tmp: str) -> dict:
     assert trainer._guard.skipped_total == 2, trainer._guard.skipped_total
     assert trainer._guard.rewinds == 0
     assert _finite(state)
+    from perceiver_tpu.obs import events as events_mod
+
+    skip_events = events_mod.default_log().events("guard_skip")
+    assert len(skip_events) == 2, skip_events  # one typed event per skip
     return {"target_step": TARGET_STEP, "reached": int(state.step),
-            "skipped_steps": trainer._guard.skipped_total}
+            "skipped_steps": trainer._guard.skipped_total,
+            "skip_events": len(skip_events)}
 
 
 def scenario_nan_rewind(tmp: str) -> dict:
@@ -435,6 +440,10 @@ def scenario_fleet_kill_replica(tmp: str) -> dict:
         crashes = fleet.supervisor.restarts_of("r0")
         retries = fleet.router.metrics.get("fleet_retries_total").value
         size = fleet.size()
+        from perceiver_tpu.obs import events as events_mod
+
+        deaths = events_mod.default_log().events("replica_death")
+        respawns = events_mod.default_log().events("replica_respawn")
     finally:
         fleet.close()
     assert not dropped, dropped
@@ -442,9 +451,14 @@ def scenario_fleet_kill_replica(tmp: str) -> dict:
     assert crashes >= 1, "victim never crashed"
     assert retries >= 1, "no request failed over"
     assert size == 3, size                # supervisor restarted the slot
+    # the typed event log saw the death AND the recovery — the same
+    # stream an operator would tail (docs/OBSERVABILITY.md)
+    assert any(e["replica"] == "r0" for e in deaths), deaths
+    assert any(e["replica"] == "r0" for e in respawns), respawns
     return {"requests": counts, "dropped": len(dropped),
             "replica_crashes": crashes, "router_retries": retries,
             "fleet_size_after": size,
+            "death_events": len(deaths), "respawn_events": len(respawns),
             "faults_fired": {"replica.crash": crashes}}
 
 
@@ -465,6 +479,9 @@ def scenario_fleet_stall(tmp: str) -> dict:
         ejections = m.get("fleet_ejections_total").value
         retries = m.get("fleet_retries_total").value
         status = fleet.statuses().get("r0", {})
+        from perceiver_tpu.obs import events as events_mod
+
+        ejection_events = events_mod.default_log().events("fleet_ejection")
     finally:
         fleet.close()
     assert not dropped, dropped
@@ -473,8 +490,13 @@ def scenario_fleet_stall(tmp: str) -> dict:
     assert retries >= 3, retries
     fired = status.get("faults_fired", {})
     assert fired.get("replica.stall") == 3, fired
+    # the breaker transition surfaced as a typed event, not just a
+    # counter — chaos asserts on the operator-facing stream
+    assert any(e["replica"] == "r0" for e in ejection_events), \
+        ejection_events
     return {"requests": counts, "dropped": len(dropped),
             "ejections": ejections, "router_retries": retries,
+            "ejection_events": len(ejection_events),
             "faults_fired": fired}
 
 
@@ -528,9 +550,15 @@ def scenario_fleet_rollout_corrupt(tmp: str) -> dict:
         t.join(300)
         versions = {rid: s.get("version")
                     for rid, s in fleet.statuses().items()}
+        from perceiver_tpu.obs import events as events_mod
+
+        rollout_events = events_mod.default_log().events("rollout_step")
     finally:
         fleet.close()
     assert aborted is not None, "corrupt rollout was not aborted"
+    # the abort left a typed rollback trail in the event log
+    assert any(e["stage"] == "rollback" for e in rollout_events), \
+        rollout_events
     assert isinstance(aborted.cause, CheckpointIntegrityError), \
         aborted.cause
     assert aborted.rolled_back and not aborted.rollback_failed, (
@@ -589,10 +617,19 @@ def scenario_fleet_rollout(tmp: str) -> dict:
         t.join(300)
         versions = {rid: s.get("version")
                     for rid, s in fleet.statuses().items()}
+        from perceiver_tpu.obs import events as events_mod
+
+        rollout_events = events_mod.default_log().events("rollout_step")
     finally:
         fleet.close()
     counts, dropped = background["counts"], background["dropped"]
     assert not dropped, dropped
+    # every replica's cutover left the full drain→cutover→undrain
+    # trail in the typed event log
+    for rid in versions:
+        stages = [e["stage"] for e in rollout_events
+                  if e["replica"] == rid and e["version"] == "v2"]
+        assert stages == ["drain", "cutover", "undrain"], (rid, stages)
     # zero FAILED requests: with siblings always available, retries
     # absorb every drain window — nothing surfaces even as typed errors
     assert counts["unavailable"] == 0, counts

@@ -25,6 +25,12 @@ optimization lever is traffic, not scheduling. ``bytes_accessed`` is
 XLA's cost-model estimate (fusion operand bytes, not measured DMA),
 so implied bandwidth above spec is reported as an accounting
 artifact, never as measured saturation.
+
+Note: this analyzes *profiler* traces (XLA op timelines captured by
+``jax.profiler`` — see the obs server's ``/profile`` endpoint and
+``TrainerConfig.profile_dir``).  Per-request *tracing* — trace_id,
+phase spans, ``/traces/<id>`` — is the other kind of trace and lives
+in ``perceiver_tpu/obs/trace.py`` (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations

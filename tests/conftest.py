@@ -99,6 +99,7 @@ _SLOW = {
     "test_resilience.py::test_terminate_on_nan_names_first_bad_step_in_block",
     "test_resilience.py::test_preemption_fault_roundtrip_with_verified_checkpoint",
     "test_resilience.py::test_trainer_loader_crash_survived_by_supervisor",
+    "test_obs.py::test_fleet_kill_yields_one_trace_with_retry",
 }
 
 

@@ -1065,3 +1065,57 @@ def test_full_lint_sweep_is_clean():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     report = lint_paths(default_lint_paths(root))
     assert report.ok, report.format()
+
+
+# --- metrics-conventions -----------------------------------------------------
+
+_METRIC_BAD_PREFIX = """
+def build(registry):
+    return registry.counter("request_count_total", "requests")
+"""
+
+_METRIC_COUNTER_NO_TOTAL = """
+def build(registry):
+    return registry.counter("serving_requests", "requests")
+"""
+
+_METRIC_GAUGE_WITH_TOTAL = """
+def build(registry):
+    return registry.gauge("fleet_size_total", "replicas")
+"""
+
+_METRIC_HISTOGRAM_CAMEL = """
+def build(registry):
+    return registry.histogram("serving_batchSize", "rows per call")
+"""
+
+_METRIC_CLEAN = """
+def build(registry):
+    registry.counter("serving_requests_total", "requests")
+    registry.gauge("fleet_size", "replicas")
+    registry.histogram("training_step_seconds", "step walltime")
+"""
+
+
+def test_lint_metrics_conventions_seeded():
+    assert "metrics-conventions" in _checks(_METRIC_BAD_PREFIX)
+    assert "metrics-conventions" in _checks(_METRIC_COUNTER_NO_TOTAL)
+    assert "metrics-conventions" in _checks(_METRIC_GAUGE_WITH_TOTAL)
+    assert "metrics-conventions" in _checks(_METRIC_HISTOGRAM_CAMEL)
+
+
+def test_lint_metrics_conventions_clean_and_non_literal():
+    assert "metrics-conventions" not in _checks(_METRIC_CLEAN)
+    # computed names are out of scope for an AST pass
+    computed = _METRIC_CLEAN.replace(
+        '"serving_requests_total"', 'f"serving_{kind}_total"')
+    assert "metrics-conventions" not in _checks(computed)
+    # unrelated .counter() attribute calls with non-string args
+    assert "metrics-conventions" not in _checks(
+        "def f(x):\n    return x.counter(3)\n")
+
+
+def test_lint_metrics_conventions_suppression_marker():
+    suppressed = _METRIC_COUNTER_NO_TOTAL.replace(
+        '"requests")', '"requests")  # graphcheck: ignore — legacy name')
+    assert "metrics-conventions" not in _checks(suppressed)

@@ -1,0 +1,548 @@
+"""Tests for the observability plane (perceiver_tpu/obs/).
+
+Unit coverage for tracing, the event log, the exposition
+parser/aggregator, the HTTP endpoint, and training telemetry; plus two
+integration gates — ``scripts/obs_check.py --fast`` as a tier-1
+subprocess (the check.py pattern) and the real-socket fleet proof that
+a request whose replica is SIGKILLed mid-flight still yields ONE trace
+with the failed hop, the retry, and the sibling's spans (slow).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from perceiver_tpu.obs import events as events_mod
+from perceiver_tpu.obs import trace as trace_mod
+from perceiver_tpu.obs.aggregate import merge_expositions
+from perceiver_tpu.obs.events import EventLog, validate_event
+from perceiver_tpu.obs import promparse
+from perceiver_tpu.obs.server import ObsServer
+from perceiver_tpu.obs.telemetry import Telemetry, install_signal_profiler
+from perceiver_tpu.obs.trace import SpanCollector, TraceBuffer
+from perceiver_tpu.serving.metrics import (
+    MetricsRegistry,
+    escape_label_value,
+    unescape_label_value,
+)
+
+# --- tracing -----------------------------------------------------------------
+
+
+def test_trace_phase_vocabulary_is_closed():
+    ctx = trace_mod.start_trace(sink=SpanCollector())
+    with pytest.raises(ValueError, match="unknown trace phase"):
+        ctx.record("warmup")
+
+
+def test_trace_span_shape_and_duration():
+    sink = SpanCollector()
+    ctx = trace_mod.start_trace(origin="router", sink=sink)
+    span = ctx.record("dispatch", duration_s=0.5, bucket="b4_s16")
+    assert span["trace_id"] == ctx.trace_id
+    assert span["phase"] == "dispatch"
+    assert span["duration_s"] == pytest.approx(0.5)
+    assert span["pid"] == os.getpid()
+    assert span["origin"] == "router"
+    assert span["attrs"] == {"bucket": "b4_s16"}
+    assert sink.spans == [span]
+
+
+def test_trace_buffer_lru_eviction_and_span_bound():
+    buf = TraceBuffer(max_traces=2, max_spans_per_trace=3)
+    for tid in ("t0", "t1", "t2"):
+        buf.add(tid, {"phase": "dispatch"})
+    assert buf.get("t0") is None  # LRU-evicted
+    assert set(buf.trace_ids()) == {"t1", "t2"}
+    for _ in range(5):
+        buf.add("t1", {"phase": "dispatch"})
+    assert len(buf.get("t1")) == 3  # bounded per trace
+    assert buf.dropped_spans == 3
+    buf.get("t1")
+
+
+def test_trace_wire_roundtrip_and_absorb_retags():
+    parent_sink = SpanCollector()
+    parent = trace_mod.start_trace(origin="router", sink=parent_sink)
+    # replica side: rebuild from the RPC envelope, collect locally
+    collector = SpanCollector()
+    remote = trace_mod.from_wire(parent.wire(), sink=collector,
+                                 origin="replica")
+    assert remote.trace_id == parent.trace_id
+    remote.record("queue_wait", duration_s=0.01)
+    remote.record("device", duration_s=0.02)
+    # router side: absorb the reply's spans, tagged with the replica id
+    parent.absorb(collector.spans, replica="r1")
+    absorbed = parent_sink.spans
+    assert [s["phase"] for s in absorbed] == ["queue_wait", "device"]
+    assert all(s["trace_id"] == parent.trace_id for s in absorbed)
+    assert all(s["attrs"]["replica"] == "r1" for s in absorbed)
+    # the replica's own copies were not mutated by the tagging
+    assert "replica" not in (collector.spans[0].get("attrs") or {})
+
+
+def test_trace_disabled_short_circuits():
+    try:
+        trace_mod.set_enabled(False)
+        assert trace_mod.start_trace() is None
+        assert trace_mod.from_wire({"trace_id": "abc"}) is None
+    finally:
+        trace_mod.set_enabled(True)
+
+
+def test_trace_attach_region_records_into_all_members():
+    sinks = [SpanCollector(), SpanCollector()]
+    ctxs = [trace_mod.start_trace(sink=s) for s in sinks]
+    with trace_mod.attach(ctxs + [None]):  # None members are dropped
+        with trace_mod.region("pad_or_pack", bucket="b4"):
+            pass
+    for sink, ctx in zip(sinks, ctxs):
+        (span,) = sink.spans
+        assert span["phase"] == "pad_or_pack"
+        assert span["trace_id"] == ctx.trace_id
+        assert span["attrs"] == {"bucket": "b4"}
+    # outside the attach block the region is a no-op
+    with trace_mod.region("dispatch"):
+        pass
+    assert all(len(s.spans) == 1 for s in sinks)
+
+
+def test_default_buffer_swap_restores():
+    mine = TraceBuffer(max_traces=4)
+    prev = trace_mod.set_default_buffer(mine)
+    try:
+        ctx = trace_mod.start_trace()
+        ctx.record("submit", duration_s=0.0)
+        assert mine.get(ctx.trace_id)
+    finally:
+        assert trace_mod.set_default_buffer(prev) is mine
+
+
+# --- event log ---------------------------------------------------------------
+
+
+def test_event_schema_validation():
+    log = EventLog()
+    event = log.emit("breaker_transition", bucket="b4_s16",
+                     old="closed", new="open")
+    validate_event(event)  # envelope + typed fields
+    with pytest.raises(ValueError, match="unknown event type"):
+        log.emit("reactor_meltdown")
+    with pytest.raises(ValueError, match="missing required"):
+        log.emit("guard_skip")  # no step
+    with pytest.raises(ValueError, match="envelope"):
+        validate_event({"type": "guard_skip", "step": 1})
+
+
+def test_event_log_ring_and_jsonl_mirror(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path)
+    log.emit("guard_skip", step=7)
+    log.emit("exec_cache", bucket="b4_s16", hit=True)
+    assert [e["type"] for e in log.events()] == ["guard_skip",
+                                                "exec_cache"]
+    assert [e["step"] for e in log.events("guard_skip")] == [7]
+    with open(path, encoding="utf-8") as f:
+        lines = [json.loads(ln) for ln in f]
+    assert lines == log.events()
+    for event in lines:
+        validate_event(event)
+
+
+def test_event_log_size_rotation(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, max_bytes=256, max_backups=2)
+    for step in range(64):
+        log.emit("guard_skip", step=step)
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".3")  # backups bounded
+    assert os.path.getsize(path) <= 256 + 128  # one line of slack
+    # the ring ignores rotation entirely
+    assert len(log.events("guard_skip")) == 64
+
+
+def test_default_log_honors_env_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(events_mod.ENV_VAR, str(tmp_path))
+    prev = events_mod.set_default_log(None)
+    try:
+        events_mod.emit("health_transition", old="READY", new="DEGRADED")
+        expected = tmp_path / f"events-{os.getpid()}.jsonl"
+        assert events_mod.default_log().path == str(expected)
+        (line,) = [json.loads(ln) for ln in expected.read_text()
+                   .splitlines()]
+        assert line["type"] == "health_transition"
+    finally:
+        events_mod.set_default_log(prev)
+
+
+# --- exposition parsing / label escaping / aggregation -----------------------
+
+
+def test_label_value_escape_roundtrip():
+    for value in ('plain', 'back\\slash', 'quo"te', 'new\nline',
+                  'all\\"\nthree'):
+        assert unescape_label_value(escape_label_value(value)) == value
+
+
+def test_registry_render_parse_roundtrip_with_hostile_labels():
+    registry = MetricsRegistry()
+    counter = registry.counter("serving_requests_total", "by outcome")
+    hostile = 'he said "no"\nand \\ left'
+    counter.labels(outcome=hostile).inc(3)
+    families = promparse.parse(registry.render())
+    (sample,) = families["serving_requests_total"].samples
+    assert sample.labels["outcome"] == hostile
+    assert sample.value == 3
+    assert promparse.check_exposition(registry.render()) == []
+
+
+def test_conformance_catches_bad_expositions():
+    untyped = "serving_mystery_total 3\n"
+    assert any("without a # TYPE" in p
+               for p in promparse.check_exposition(untyped))
+    non_monotone = (
+        "# TYPE serving_latency histogram\n"
+        'serving_latency_bucket{le="0.1"} 5\n'
+        'serving_latency_bucket{le="1"} 3\n'
+        'serving_latency_bucket{le="+Inf"} 3\n'
+        "serving_latency_count 3\n"
+        "serving_latency_sum 1.0\n")
+    assert any("not cumulative" in p
+               for p in promparse.check_exposition(non_monotone))
+    no_inf = (
+        "# TYPE serving_latency histogram\n"
+        'serving_latency_bucket{le="1"} 3\n'
+        "serving_latency_count 3\n"
+        "serving_latency_sum 1.0\n")
+    assert any("+Inf" in p for p in promparse.check_exposition(no_inf))
+    inf_mismatch = (
+        "# TYPE serving_latency histogram\n"
+        'serving_latency_bucket{le="+Inf"} 4\n'
+        "serving_latency_count 3\n"
+        "serving_latency_sum 1.0\n")
+    assert any("_count" in p
+               for p in promparse.check_exposition(inf_mismatch))
+
+
+def test_merge_expositions_injects_replica_label():
+    replica = ("# TYPE serving_bucket_dispatch_total counter\n"
+               'serving_bucket_dispatch_total{bucket="b4_s16"} 2\n')
+    router = ("# TYPE fleet_size gauge\nfleet_size 2\n")
+    merged = merge_expositions({"r0": replica, "r1": replica},
+                               extra_texts=(router,))
+    assert promparse.check_exposition(merged) == []
+    families = promparse.parse(merged)
+    dispatch = families["serving_bucket_dispatch_total"]
+    assert {s.labels["replica"] for s in dispatch.samples} == {"r0", "r1"}
+    assert all(s.labels["bucket"] == "b4_s16" for s in dispatch.samples)
+    # router series appended verbatim, unlabeled
+    (size,) = families["fleet_size"].samples
+    assert "replica" not in size.labels
+
+
+def test_merge_expositions_rejects_kind_mismatch():
+    a = "# TYPE serving_queue_depth gauge\nserving_queue_depth 1\n"
+    b = "# TYPE serving_queue_depth counter\nserving_queue_depth 1\n"
+    with pytest.raises(promparse.ParseError, match="kind mismatch"):
+        merge_expositions({"r0": a, "r1": b})
+
+
+def test_serving_batcher_registry_conforms():
+    """The batcher's serving_* registry renders a clean exposition
+    after real traffic (histograms populated, counters ticked)."""
+    from perceiver_tpu.serving.batcher import MicroBatcher
+
+    registry = MetricsRegistry()
+    batcher = MicroBatcher(lambda batch: [{"ok": True} for _ in batch],
+                           max_batch=4, max_delay_ms=1.0,
+                           metrics=registry)
+    try:
+        futures = [batcher.submit({"i": i}) for i in range(6)]
+        for fut in futures:
+            fut.result(timeout=10)
+    finally:
+        batcher.close()
+    assert promparse.check_exposition(registry.render()) == []
+
+
+def test_fleet_router_registry_conforms():
+    from perceiver_tpu.fleet.router import Router
+
+    router = Router(prober_interval_s=None)
+    try:
+        assert promparse.check_exposition(router.metrics.render()) == []
+    finally:
+        router.close()
+
+
+def test_training_telemetry_registry_conforms(tmp_path):
+    telemetry = Telemetry(str(tmp_path))
+    telemetry.step(1, 2.5, steps_per_sec=4.0, samples_per_sec=128.0)
+    telemetry.guard_skip(2)
+    assert promparse.check_exposition(telemetry.registry.render()) == []
+
+
+# --- HTTP endpoint -----------------------------------------------------------
+
+
+def _get(url: str):
+    req = urllib.request.Request(url)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8"), \
+                resp.headers.get("Content-Type", "")
+    except urllib.error.HTTPError as e:  # 4xx/5xx still carry a body
+        return e.code, e.read().decode("utf-8"), \
+            e.headers.get("Content-Type", "")
+
+
+def test_obs_server_endpoints():
+    registry = MetricsRegistry()
+    registry.gauge("fleet_size", "replicas").set(2)
+    buf = TraceBuffer()
+    ctx = trace_mod.start_trace(sink=buf)
+    ctx.record("submit", duration_s=0.001)
+    healthy = {"flag": True}
+    server = ObsServer(
+        metrics_fn=registry.render,
+        health_fn=lambda: {"ok": healthy["flag"]},
+        trace_buffer=buf)
+    try:
+        status, body, ctype = _get(f"{server.url}/metrics")
+        assert status == 200 and "version=0.0.4" in ctype
+        assert promparse.check_exposition(body) == []
+
+        status, body, _ = _get(f"{server.url}/healthz")
+        assert status == 200 and json.loads(body)["ok"] is True
+        healthy["flag"] = False
+        status, _, _ = _get(f"{server.url}/healthz")
+        assert status == 503
+
+        status, body, _ = _get(f"{server.url}/traces")
+        assert status == 200
+        assert json.loads(body)["traces"] == [ctx.trace_id]
+
+        status, body, _ = _get(f"{server.url}/traces/{ctx.trace_id}")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["trace_id"] == ctx.trace_id
+        assert [s["phase"] for s in payload["spans"]] == ["submit"]
+
+        status, _, _ = _get(f"{server.url}/traces/nonexistent")
+        assert status == 404
+        status, _, _ = _get(f"{server.url}/nope")
+        assert status == 404
+        # no profile_dir configured -> 501, never a crash
+        status, body, _ = _get(f"{server.url}/profile?seconds=1")
+        assert status == 501 and "profile_dir" in body
+    finally:
+        server.close()
+
+
+# --- training telemetry ------------------------------------------------------
+
+
+def test_telemetry_jsonl_and_counters(tmp_path):
+    telemetry = Telemetry(str(tmp_path))
+    telemetry.step(10, 1.25, steps_delta=5, steps_per_sec=50.0,
+                   samples_per_sec=1600.0, mfu=0.31)
+    telemetry.step(20, 1.10, steps_delta=10)
+    telemetry.guard_skip(21)
+    telemetry.guard_rewind(22)
+    telemetry.checkpoint_seal(str(tmp_path / "ckpt-20"))
+    telemetry.preempt_checkpoint(23)
+
+    with open(tmp_path / "telemetry.jsonl", encoding="utf-8") as f:
+        lines = [json.loads(ln) for ln in f]
+    for event in lines:
+        validate_event(event)
+    steps = [e for e in lines if e["type"] == "train_step"]
+    assert [e["step"] for e in steps] == [10, 20]
+    assert steps[0]["mfu"] == pytest.approx(0.31)  # extras kept
+
+    registry = telemetry.registry
+    assert registry.get("training_steps_total").value == 15
+    assert registry.get("training_loss").value == pytest.approx(1.10)
+    assert registry.get("training_guard_skips_total").value == 1
+    assert registry.get("training_guard_rewinds_total").value == 1
+    assert registry.get("training_checkpoint_seals_total").value == 1
+    assert registry.get("training_preempt_checkpoints_total").value == 1
+
+
+def test_signal_profiler_install_uninstall(tmp_path):
+    import signal
+
+    prev_handler = signal.getsignal(signal.SIGUSR1)
+    uninstall = install_signal_profiler(str(tmp_path))
+    assert callable(uninstall)
+    assert signal.getsignal(signal.SIGUSR1) is not prev_handler
+    uninstall()
+    assert signal.getsignal(signal.SIGUSR1) is prev_handler
+
+
+def test_signal_profiler_off_main_thread_degrades(tmp_path):
+    result = {}
+
+    def worker():
+        result["value"] = install_signal_profiler(str(tmp_path))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(10)
+    assert result["value"] is None  # manual profiling, no crash
+
+
+# --- overhead budget ---------------------------------------------------------
+
+
+def test_tracing_overhead_within_pinned_bounds():
+    """The hot-path budget the plane promises: a span record is a dict
+    build + list append (<100us, ~2us in practice); the disabled
+    ``start_trace`` is one global read (<10us, ~0.1us)."""
+    import time
+
+    ctx = trace_mod.start_trace(sink=SpanCollector())
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ctx.record("dispatch", duration_s=0.0)
+    per_span_us = (time.perf_counter() - t0) / n * 1e6
+    try:
+        trace_mod.set_enabled(False)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            trace_mod.start_trace()
+        disabled_us = (time.perf_counter() - t0) / n * 1e6
+    finally:
+        trace_mod.set_enabled(True)
+    assert per_span_us < 100.0, per_span_us
+    assert disabled_us < 10.0, disabled_us
+
+
+# --- integration gates -------------------------------------------------------
+
+
+def test_obs_check_fast_gate():
+    """``scripts/obs_check.py --fast`` as a literal subprocess gate:
+    a real 2-replica fleet under traced traffic proves the e2e trace,
+    the aggregated exposition, the event log, the zero-compile budget,
+    and the overhead bounds — all in one fresh process."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "obs_check.py"),
+         "--fast"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, f"\n{proc.stdout}\n{proc.stderr}"
+
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    by_metric = {ln["metric"]: ln for ln in lines}
+    for line in lines:
+        assert {"metric", "value", "unit", "vs_baseline",
+                "detail"} <= set(line)
+    assert by_metric["obs_check"]["value"] == 1.0
+    checks = [ln for ln in lines if ln["metric"] != "obs_check"]
+    assert len(checks) == 5
+    assert all(ln["value"] == 1.0 for ln in checks)
+    trace_detail = by_metric["obs_trace_complete"]["detail"]
+    assert trace_detail["processes"] >= 2
+    deltas = by_metric["obs_zero_compiles"]["detail"][
+        "post_warmup_compile_deltas"]
+    assert deltas and all(d == 0 for d in deltas.values())
+
+
+def test_fleet_kill_yields_one_trace_with_retry(tmp_path, monkeypatch):
+    """ISSUE acceptance: SIGKILL a replica mid-dispatch and prove ONE
+    trace — fetched from the live ``/traces/<id>`` socket — carries
+    the failed ``rpc_hop``, the ``retry``, the re-``route``, and the
+    sibling's server-side spans, across at least two processes."""
+    import numpy as np
+
+    from perceiver_tpu.fleet import Fleet
+    from perceiver_tpu.serving.errors import ServingError
+    from perceiver_tpu.serving.graphs import build_serve_graph
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+    from perceiver_tpu.training.checkpoint import ParamsVersionStore
+
+    task_kwargs = dict(
+        vocab_size=110, max_seq_len=32, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+    graph = build_serve_graph(MaskedLanguageModelTask(**task_kwargs))
+    store = ParamsVersionStore(str(tmp_path / "store"))
+    store.publish("v1", graph.init_params(0), set_current=True)
+    spec = {"task_class": "MaskedLanguageModelTask",
+            "task_kwargs": task_kwargs,
+            "batch_buckets": [4], "seq_buckets": [16],
+            "store_dir": store.directory, "version": "v1", "seed": 0}
+    monkeypatch.setenv("PERCEIVER_EXEC_CACHE",
+                       str(tmp_path / "exec_cache"))
+
+    buf = TraceBuffer(max_traces=512)
+    prev_buf = trace_mod.set_default_buffer(buf)
+    # r0 SIGKILLs itself mid-dispatch on its 3rd request; r1 is the
+    # surviving sibling the router must fail over to
+    fleet = Fleet(
+        spec, str(tmp_path / "fleet"), replicas=2, max_restarts=3,
+        dispatch_timeout_s=10.0,
+        per_replica_env={"r0": {
+            "PERCEIVER_FAULTS": "replica.crash@at=2"}})
+    try:
+        obs = fleet.start_obs()
+        rng = np.random.default_rng(0)
+        retried_id = None
+        for _ in range(40):
+            arrays = {"input_ids": rng.integers(
+                          3, 110, (2, 16)).astype(np.int32),
+                      "pad_mask": np.zeros((2, 16), bool)}
+            try:
+                reply = fleet.submit(arrays)
+            except ServingError:
+                continue  # typed refusal mid-crash — keep driving
+            tid = reply.get("trace_id")
+            spans = buf.get(tid) or []
+            if any(s["phase"] == "retry" for s in spans):
+                retried_id = tid
+                break
+        assert retried_id is not None, "no request ever hit the crash"
+
+        status, body, _ = _get(f"{obs.url}/traces/{retried_id}")
+        assert status == 200, (status, body)
+        payload = json.loads(body)
+        assert payload["trace_id"] == retried_id
+        spans = payload["spans"]
+        assert all(s["trace_id"] == retried_id for s in spans)
+
+        by_phase = {}
+        for s in spans:
+            by_phase.setdefault(s["phase"], []).append(s)
+        # the failed hop, the backoff, and the re-route are all there
+        failed = [s for s in by_phase["rpc_hop"]
+                  if (s.get("attrs") or {}).get("ok") is False]
+        ok = [s for s in by_phase["rpc_hop"]
+              if (s.get("attrs") or {}).get("ok") is True]
+        assert failed and ok, by_phase["rpc_hop"]
+        assert "retry" in by_phase
+        assert len(by_phase["route"]) >= 2  # picked, failed, re-picked
+        # the sibling's server-side spans were absorbed into the SAME
+        # trace, tagged with the survivor's id, from another process
+        survivor = (ok[0].get("attrs") or {})["replica"]
+        assert survivor != (failed[0].get("attrs") or {})["replica"]
+        for phase in ("queue_wait", "pad_or_pack", "dispatch", "device"):
+            assert phase in by_phase, sorted(by_phase)
+            tags = [(s.get("attrs") or {}).get("replica")
+                    for s in by_phase[phase]]
+            assert survivor in tags, (phase, tags)
+        assert len({s["pid"] for s in spans}) >= 2
+    finally:
+        fleet.close()
+        trace_mod.set_default_buffer(prev_buf)
