@@ -103,6 +103,20 @@ Rules (names are the ``check`` field of emitted violations):
     docs/SERVING.md "Fleet") requires every socket operation to be
     able to time out.
 
+``distributed-blocking-io``
+    The multi-host discipline (modules under
+    ``perceiver_tpu/distributed/``): the router rule's socket checks,
+    PLUS argument-less barrier-style waits — ``.wait()`` / ``.join()``
+    / ``.get()`` / ``.acquire()`` with no positional argument and no
+    ``timeout=`` keyword. A process group's failure mode is the
+    unbounded collective wait (a dead member wedges every survivor),
+    so every rendezvous, queue pop, thread join, and lock acquire in
+    the distributed layer must carry an explicit deadline the group
+    supervisor can act on (docs/RESILIENCE.md "Multi-host"). Calls
+    with any positional argument pass (``d.get(key)``,
+    ``done.wait(5)``); a genuinely-unbounded wait that is safe
+    suppresses per line with a reason.
+
 Tracing detection is local and conservative: functions decorated with
 ``jax.jit`` / ``partial(jax.jit, ...)``, functions passed to a
 ``jax.jit(...)`` call anywhere in the module, and everything nested
@@ -585,6 +599,43 @@ def _check_router_blocking_io(tree: ast.AST, path: str) -> List[Violation]:
     return out
 
 
+# distributed/: socket discipline + no argument-less barrier waits
+_BARRIER_WAIT_ATTRS = {"wait", "join", "get", "acquire"}
+
+
+def _check_distributed_blocking_io(tree: ast.AST,
+                                   path: str) -> List[Violation]:
+    """``distributed-blocking-io``: see the module docstring. Socket
+    checks mirror ``router-blocking-io`` (same receiver-key match);
+    the barrier-wait check is purely syntactic — no positional args
+    and no ``timeout=`` keyword means the call can block forever."""
+    out: List[Violation] = []
+    for v in _check_router_blocking_io(tree, path):
+        out.append(Violation(
+            check="distributed-blocking-io", where=v.where,
+            message=v.message.replace(
+                "fleet hot path", "distributed code path")))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BARRIER_WAIT_ATTRS):
+            continue
+        if node.args or any(kw.arg == "timeout"
+                            for kw in node.keywords):
+            continue
+        key = _receiver_key(node.func) or "<expr>"
+        out.append(Violation(
+            check="distributed-blocking-io",
+            where=f"{path}:{node.lineno}",
+            message=f"argument-less {key}.{node.func.attr}() in a "
+                    "distributed module can block forever — a dead "
+                    "group member must surface as a typed timeout the "
+                    "supervisor can re-form on, never a wedged "
+                    "barrier; pass a timeout (or suppress with "
+                    "'graphcheck: ignore' and a reason)"))
+    return out
+
+
 # metric registration sites: one naming convention for all planes
 _METRIC_KINDS = {"counter", "gauge", "histogram"}
 _METRIC_NAME_RE = re.compile(r"^(serving|training|fleet)_[a-z0-9_]+$")
@@ -694,6 +745,8 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
         violations.extend(_check_engine_syncs(tree, imports, path))
     if "perceiver_tpu/fleet/" in norm:
         violations.extend(_check_router_blocking_io(tree, path))
+    if "perceiver_tpu/distributed/" in norm:
+        violations.extend(_check_distributed_blocking_io(tree, path))
     if "perceiver_tpu/parallel/" in norm \
             or norm.endswith("perceiver_tpu/training/spmd.py"):
         violations.extend(_check_unsharded_pjit(tree, path))
@@ -751,7 +804,8 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
 ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
              "impl-field-validation", "serving-host-sync",
              "uncached-compile", "silent-swallow", "router-blocking-io",
-             "unsharded-pjit", "metrics-conventions")
+             "distributed-blocking-io", "unsharded-pjit",
+             "metrics-conventions")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

@@ -48,10 +48,10 @@ class PrefetchIterator:
     ``depth`` bounds host memory: at most ``depth`` assembled batches
     exist beyond the one being consumed. ``max_restarts`` /
     ``backoff_s`` / ``stall_timeout_s`` configure the producer
-    supervisor (see module docstring). Proxies ``len`` and
-    ``set_epoch`` so it can stand in for a ``BatchIterator``
+    supervisor (see module docstring). Proxies ``len``, ``set_epoch``
+    and ``set_sharding`` so it can stand in for a ``BatchIterator``
     (``perceiver_tpu.data.core``) anywhere, including epoch-seeded
-    shuffling.
+    shuffling and per-process multi-host sharding.
     """
 
     def __init__(self, inner, depth: int = 2, max_restarts: int = 0,
@@ -79,6 +79,20 @@ class PrefetchIterator:
     def set_epoch(self, epoch: int):
         if hasattr(self.inner, "set_epoch"):
             self.inner.set_epoch(epoch)
+
+    def set_sharding(self, num_shards: int, shard_index: int,
+                     pad_remainder: bool = False):
+        """Proxy per-process sharding so a prefetched loader composes
+        with multi-host runs (``distributed/bootstrap.py``): the
+        producer then iterates only this process's disjoint shard, and
+        a supervised restart re-derives the same strided slice — the
+        no-dups/no-gaps restart guarantee holds per shard, hence
+        globally."""
+        if not hasattr(self.inner, "set_sharding"):
+            raise ValueError(
+                f"inner loader {type(self.inner).__name__} is not "
+                f"process-shardable (no set_sharding)")
+        self.inner.set_sharding(num_shards, shard_index, pad_remainder)
 
     # -- producer ---------------------------------------------------------
 

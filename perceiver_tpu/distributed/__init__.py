@@ -1,0 +1,50 @@
+"""Multi-host composition (ROADMAP item 1; docs/RESILIENCE.md and
+docs/SERVING.md "Multi-host" sections).
+
+r10 landed the single-host SPMD pieces (pjit train step, ZeRO-sharded
+opt state, sharded serve executable); this package composes them
+across *processes* — and makes losing a host a rehearsed, chaos-gated
+event rather than a hang:
+
+- :mod:`~perceiver_tpu.distributed.bootstrap` — timeboxed, typed
+  ``jax.distributed`` rendezvous + per-process disjoint data sharding
+  layered on the supervised prefetcher;
+- :mod:`~perceiver_tpu.distributed.group` — training process-group
+  supervisor: any member death tears down and re-forms the group with
+  backoff under a poison budget; workers resume from the newest
+  sha256-verified anchor and replay the epoch-seeded stream
+  (bitwise-identical loss curve);
+- :mod:`~perceiver_tpu.distributed.worker` — the group-member
+  entrypoint (``python -m perceiver_tpu.distributed.worker``);
+- :mod:`~perceiver_tpu.distributed.serving_group` — a fleet replica
+  as a process group, with the two-phase (stage-then-commit) param
+  cutover that never serves torn params.
+
+Chaos coverage: ``scripts/chaos.py --dist``.
+"""
+
+from perceiver_tpu.distributed.bootstrap import (
+    BootstrapError,
+    DistributedConfig,
+    RendezvousTimeout,
+    initialize,
+    process_sharded_loader,
+)
+from perceiver_tpu.distributed.group import (
+    GroupError,
+    GroupPoisoned,
+    GroupSupervisor,
+    GroupTimeout,
+)
+
+__all__ = [
+    "BootstrapError",
+    "DistributedConfig",
+    "GroupError",
+    "GroupPoisoned",
+    "GroupSupervisor",
+    "GroupTimeout",
+    "RendezvousTimeout",
+    "initialize",
+    "process_sharded_loader",
+]

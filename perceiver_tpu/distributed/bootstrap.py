@@ -1,0 +1,211 @@
+"""Coordinator bootstrap: timeboxed ``jax.distributed`` rendezvous.
+
+``jax.distributed.initialize`` is the multi-host entry gate: every
+process dials the coordinator and blocks until the full group arrives.
+Its failure mode is the worst kind for a fleet — an *unbounded* wait
+(a dead coordinator or a missing member leaves every surviving host
+wedged inside a gRPC retry loop, burning its pod reservation). This
+module wraps the call so bootstrap failures are **timeboxed and
+typed** (docs/RESILIENCE.md "Multi-host"):
+
+- the rendezvous runs under a hard deadline
+  (``DistributedConfig.rendezvous_timeout_s``); missing it raises
+  :class:`RendezvousTimeout` and emits a ``rendezvous_timeout`` event
+  instead of hanging;
+- any other bootstrap failure surfaces as :class:`BootstrapError` with
+  the coordinator address in the message — the group supervisor
+  (``distributed/group.py``) treats a typed bootstrap exit as a clean
+  re-form trigger, never a hang.
+
+``process_sharded_loader`` is the data half of the launcher: it layers
+the per-process disjoint shard (``data/core.BatchIterator
+.set_sharding`` — same seed, strided slice) *under* the supervised
+prefetch producer (``data/prefetch.PrefetchIterator``), so each
+process draws a deterministic, non-overlapping stream AND a producer
+crash on one host restarts without duplicating or skipping a batch
+anywhere in the fleet (the r06 no-dups/no-gaps guarantee, extended
+across the process dimension).
+
+Every wait in this module carries an explicit timeout — enforced by
+the ``distributed-blocking-io`` lint rule (``analysis/lint.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import threading
+from typing import Optional
+
+from perceiver_tpu.obs import events as events_mod
+
+
+class BootstrapError(RuntimeError):
+    """Typed failure of the multi-host bootstrap (coordinator dial,
+    cluster formation, or local device init) — never a silent hang."""
+
+
+class RendezvousTimeout(BootstrapError):
+    """The process group did not form within the rendezvous timebox."""
+
+    def __init__(self, coordinator: str, timeout_s: float,
+                 cause: Optional[BaseException] = None):
+        super().__init__(
+            f"rendezvous at {coordinator} did not complete within "
+            f"{timeout_s:.1f}s"
+            + (f" ({type(cause).__name__}: {cause})" if cause else ""))
+        self.coordinator = coordinator
+        self.timeout_s = timeout_s
+        self.cause = cause
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """One process's slot in the group, as the launcher hands it out.
+
+    ``num_processes == 1`` is a legitimate degenerate group (the chaos
+    harness exercises group supervision without cross-process
+    collectives this way): no cluster is formed and no coordinator is
+    required, but the rest of the machinery — supervision, anchors,
+    replay — behaves identically.
+    """
+
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    rendezvous_timeout_s: float = 60.0
+
+    def __post_init__(self):
+        if self.num_processes < 1:
+            raise ValueError(f"num_processes must be >= 1, got "
+                             f"{self.num_processes}")
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(f"process_id {self.process_id} not in "
+                             f"[0, {self.num_processes})")
+        if self.rendezvous_timeout_s <= 0:
+            raise ValueError("rendezvous_timeout_s must be positive")
+
+
+_TIMEOUT_SIGNATURES = ("deadline", "timed out", "timeout",
+                       "unavailable", "failed to connect")
+
+
+def initialize(config: DistributedConfig, *,
+               _initialize_fn=None) -> None:
+    """Form the ``jax.distributed`` cluster under a hard deadline.
+
+    Runs the blocking initialize on a watchdog thread: if the group
+    has not formed when the timebox expires, a typed
+    :class:`RendezvousTimeout` is raised (the thread is abandoned —
+    bootstrap failure means this process exits, which is exactly what
+    the group supervisor expects to see). ``_initialize_fn`` is the
+    test seam (defaults to ``jax.distributed.initialize``).
+    """
+    if config.num_processes == 1:
+        return  # degenerate group: nothing to rendezvous with
+
+    if _initialize_fn is None:
+        import jax
+
+        _initialize_fn = jax.distributed.initialize
+    kwargs = dict(coordinator_address=config.coordinator_address,
+                  num_processes=config.num_processes,
+                  process_id=config.process_id)
+    # newer jax exposes its own rendezvous deadline — pass one through
+    # so the gRPC layer eventually stops retrying, but set it WELL past
+    # ours: some jaxlibs answer their own expired deadline with
+    # LOG(FATAL) (SIGABRT) instead of a catchable error, and that must
+    # never beat the typed timeout below
+    try:
+        accepted = inspect.signature(_initialize_fn).parameters
+    except (TypeError, ValueError):  # C-level or exotic callables
+        accepted = {}
+    if "initialization_timeout" in accepted:
+        kwargs["initialization_timeout"] = int(
+            max(1, config.rendezvous_timeout_s)) + 60
+
+    outcome: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            _initialize_fn(**kwargs)
+        except BaseException as e:  # handed to the watchdog, re-typed
+            outcome["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="distributed-rendezvous")
+    t.start()
+    if not done.wait(config.rendezvous_timeout_s):
+        events_mod.emit("rendezvous_timeout",
+                        coordinator=config.coordinator_address,
+                        timeout_s=config.rendezvous_timeout_s)
+        raise RendezvousTimeout(config.coordinator_address,
+                                config.rendezvous_timeout_s)
+    error = outcome.get("error")
+    if error is not None:
+        msg = str(error).lower()
+        if any(sig in msg for sig in _TIMEOUT_SIGNATURES):
+            events_mod.emit("rendezvous_timeout",
+                            coordinator=config.coordinator_address,
+                            timeout_s=config.rendezvous_timeout_s)
+            raise RendezvousTimeout(config.coordinator_address,
+                                    config.rendezvous_timeout_s,
+                                    cause=error) from error
+        raise BootstrapError(
+            f"bootstrap at {config.coordinator_address} failed: "
+            f"{type(error).__name__}: {error}") from error
+
+
+def shutdown() -> None:
+    """Tear down this process's membership (idempotent; safe to call
+    when :func:`initialize` never ran or was degenerate)."""
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        pass  # never initialized — nothing to leave
+
+
+def process_sharded_loader(loader, *,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None,
+                           pad_remainder: bool = False,
+                           prefetch_depth: int = 2,
+                           max_restarts: int = 3,
+                           backoff_s: float = 0.05,
+                           stall_timeout_s: Optional[float] = None):
+    """Disjoint deterministic per-process shard + supervised prefetch.
+
+    Sharding first, prefetch second: the producer thread then only
+    ever iterates this process's shard, so a supervised restart
+    re-derives the same strided slice and repositions within it —
+    the global stream stays exactly-once even when one process's
+    producer dies mid-epoch (``tests/test_distributed.py``).
+
+    ``num_processes``/``process_id`` default to the live
+    ``jax.distributed`` topology so the launcher can call this right
+    after :func:`initialize` with no extra plumbing.
+    """
+    from perceiver_tpu.data.prefetch import PrefetchIterator
+
+    if num_processes is None or process_id is None:
+        import jax
+
+        num_processes = jax.process_count()
+        process_id = jax.process_index()
+    if num_processes > 1:
+        if not hasattr(loader, "set_sharding"):
+            raise ValueError(
+                f"{num_processes}-process run needs a process-shardable "
+                f"loader (set_sharding); got {type(loader).__name__}")
+        loader.set_sharding(num_processes, process_id, pad_remainder)
+    if prefetch_depth <= 0:
+        return loader
+    return PrefetchIterator(loader, depth=prefetch_depth,
+                            max_restarts=max_restarts,
+                            backoff_s=backoff_s,
+                            stall_timeout_s=stall_timeout_s)

@@ -1,0 +1,207 @@
+"""Process-group supervisor: tear down and re-form on member death.
+
+The serving fleet learned this lesson in r06: a crashed replica is not
+an error, it is an *event* with a rehearsed response (backoff, respawn,
+poison-pill budget — ``fleet/supervisor.py``). A ``jax.distributed``
+training group raises the stakes: the processes are not independent —
+one dead member wedges every collective on the survivors, so the only
+safe response to losing ANY host is to kill the REST, pick a fresh
+coordinator port, and re-form the whole group as a new *generation*.
+Recovery of the training state is the workers' job (each generation
+restores from the newest sha256-verified anchor and replays the
+epoch-seeded stream — see ``distributed/worker.py`` and the
+``dist_kill_train_host`` chaos scenario); this module's job is purely
+the group lifecycle:
+
+- spawn N members (argv supplied per (rank, generation) so the chaos
+  harness can arm a fault in generation 0 only);
+- watch them; on any non-zero exit, kill survivors, emit
+  ``host_leave`` + ``group_reform``, back off exponentially, re-form;
+- give up with a typed :class:`GroupPoisoned` once the re-form budget
+  is spent (a deterministic crasher must not flap forever);
+- finish when every member of a generation exits 0.
+
+Every wait here carries an explicit timeout (``distributed-blocking-io``
+lint rule); the overall :meth:`GroupSupervisor.run` deadline turns a
+hung member into a typed :class:`GroupTimeout`, never a stuck harness.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from perceiver_tpu.obs import events as events_mod
+
+
+class GroupError(RuntimeError):
+    """Base for typed process-group lifecycle failures."""
+
+
+class GroupPoisoned(GroupError):
+    """Re-form budget spent: the group kept dying every generation."""
+
+    def __init__(self, name: str, reforms: int, last_exit: int):
+        super().__init__(
+            f"group {name} poisoned after {reforms} re-forms "
+            f"(last member exit code {last_exit})")
+        self.reforms = reforms
+        self.last_exit = last_exit
+
+
+class GroupTimeout(GroupError):
+    """The group did not finish within the caller's deadline."""
+
+
+def free_port() -> int:
+    """A currently-unbound localhost TCP port (for the coordinator)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Member:
+    """One spawned group member plus its log file handle."""
+
+    def __init__(self, rank: int, proc: subprocess.Popen, log_path: str,
+                 log_file):
+        self.rank = rank
+        self.proc = proc
+        self.log_path = log_path
+        self._log_file = log_file
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+
+    def close(self) -> None:
+        try:
+            self._log_file.close()
+        except OSError:
+            pass
+
+
+class GroupSupervisor:
+    """Run a multi-process group to completion, re-forming on death.
+
+    ``spawn_argv(rank, num_processes, coordinator_address, generation)``
+    returns the argv for one member; ``member_env(rank, generation)``
+    (optional) returns extra env vars for it — the seam the chaos
+    harness uses to arm ``train.kill`` in generation 0 only, so the
+    re-formed group runs clean.
+    """
+
+    def __init__(self, spawn_argv: Callable[[int, int, str, int], List[str]],
+                 num_processes: int, *, workdir: str,
+                 max_reforms: int = 3, backoff_s: float = 0.2,
+                 poll_interval_s: float = 0.1,
+                 member_env: Optional[Callable[[int, int], Dict[str, str]]] = None,
+                 name: str = "pg0"):
+        if num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        self._spawn_argv = spawn_argv
+        self.num_processes = num_processes
+        self.workdir = workdir
+        self.max_reforms = max_reforms
+        self.backoff_s = backoff_s
+        self.poll_interval_s = poll_interval_s
+        self._member_env = member_env
+        self.name = name
+        self.generation = 0
+        self.reforms = 0
+        self._members: List[_Member] = []
+        self._closed = threading.Event()
+        os.makedirs(workdir, exist_ok=True)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _spawn_generation(self) -> None:
+        coordinator = f"127.0.0.1:{free_port()}"
+        for rank in range(self.num_processes):
+            env = dict(os.environ)
+            if self._member_env is not None:
+                env.update(self._member_env(rank, self.generation) or {})
+            log_path = os.path.join(
+                self.workdir,
+                f"{self.name}.g{self.generation}.r{rank}.log")
+            log_file = open(log_path, "wb")
+            proc = subprocess.Popen(
+                self._spawn_argv(rank, self.num_processes, coordinator,
+                                 self.generation),
+                stdout=log_file, stderr=subprocess.STDOUT, env=env)
+            self._members.append(_Member(rank, proc, log_path, log_file))
+            events_mod.emit("host_join", group=self.name, rank=rank,
+                            generation=self.generation, pid=proc.pid)
+
+    def _teardown(self) -> None:
+        for m in self._members:
+            m.kill()
+        for m in self._members:
+            try:
+                m.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass  # SIGKILLed above; the OS will reap it
+            m.close()
+        self._members = []
+
+    def member_logs(self) -> List[str]:
+        """Log paths of the CURRENT generation's members (for the
+        chaos harness to stitch telemetry / scrape typed errors)."""
+        return [m.log_path for m in self._members]
+
+    # -- supervision ---------------------------------------------------------
+
+    def run(self, timeout_s: float = 600.0) -> int:
+        """Block until one generation finishes clean; return the number
+        of re-forms it took. Typed errors on poison or deadline."""
+        deadline = time.monotonic() + timeout_s
+        self._spawn_generation()
+        try:
+            while True:
+                if time.monotonic() > deadline:
+                    raise GroupTimeout(
+                        f"group {self.name} still running after "
+                        f"{timeout_s:.0f}s (generation {self.generation})")
+                codes = [m.poll() for m in self._members]
+                if any(c is not None and c != 0 for c in codes):
+                    dead = next(m for m, c in zip(self._members, codes)
+                                if c is not None and c != 0)
+                    exit_code = codes[dead.rank]
+                    events_mod.emit("host_leave", group=self.name,
+                                    rank=dead.rank,
+                                    generation=self.generation,
+                                    exit_code=exit_code)
+                    self._teardown()  # survivors can't collective on
+                    if self.reforms >= self.max_reforms:
+                        raise GroupPoisoned(self.name, self.reforms,
+                                            exit_code)
+                    delay = self.backoff_s * (2 ** self.reforms)
+                    self.reforms += 1
+                    self.generation += 1
+                    events_mod.emit("group_reform", group=self.name,
+                                    generation=self.generation,
+                                    reforms=self.reforms,
+                                    backoff_s=delay)
+                    if self._closed.wait(delay):
+                        raise GroupError(f"group {self.name} closed "
+                                         f"during backoff")
+                    self._spawn_generation()
+                    continue
+                if all(c == 0 for c in codes):
+                    return self.reforms
+                if self._closed.wait(self.poll_interval_s):
+                    raise GroupError(f"group {self.name} closed")
+        finally:
+            self._teardown()
+
+    def close(self) -> None:
+        """Abort supervision and kill any live members."""
+        self._closed.set()
+        self._teardown()

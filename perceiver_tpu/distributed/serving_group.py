@@ -1,0 +1,302 @@
+"""Process-group fleet replicas + the two-phase param cutover.
+
+r06 made a fleet replica ONE process; on a multi-host slice it is a
+*group* of processes jointly hosting the sharded serve executable
+(``analysis/targets.make_sharded_serve_step``, r10). This module makes
+that composition a drop-in at the two existing seams:
+
+- :class:`ReplicaGroup` quacks like
+  ``fleet.supervisor.ReplicaProcess`` (``.handle``/``.poll``/
+  ``.kill``/``.stop``/``.pid``) so the fleet ``Supervisor`` supervises
+  a group exactly like a process. One dead member wedges the whole
+  group's collectives, so ``poll()`` reports the group dead the moment
+  ANY member dies (tearing down the survivors) — the supervisor's
+  normal death path then re-forms the group with backoff, and the
+  router's retry-on-sibling keeps traffic at zero drops throughout
+  (chaos scenario ``dist_kill_serve_host``).
+- :class:`GroupReplicaHandle` quacks like ``RpcReplicaHandle`` so the
+  router and rollout drive a group unchanged. ``update_version`` is
+  where a group differs fundamentally from a process: swapping members
+  one-by-one would serve *torn* params (half the shards old, half
+  new), so the swap is **two-phase** — stage the verified version into
+  memory on EVERY member (traffic untouched), then commit member-wise;
+  only an all-member ack completes the cutover. A failure while
+  staging aborts cleanly; a failure while committing (a member killed
+  between stage and swap — chaos scenario ``dist_cutover_kill``) rolls
+  every committed member back to the previous version and raises
+  :class:`GroupCutoverError`, which ``fleet.rollout.rolling_update``
+  converts into its fleet-level rollback — ``ParamsVersionStore``'s
+  CURRENT pointer never moves (docs/SERVING.md "Multi-host").
+
+On this CPU test rig only the lead member actually answers
+dispatches (cross-process collectives need a real multi-host backend
+— ``tests/conftest.py`` probe); members still hold params in lockstep,
+which is the property the cutover protocol protects. On a TPU slice
+the lead fans the dispatch into the group's collective.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from perceiver_tpu.fleet.rpc import RpcError
+from perceiver_tpu.fleet.supervisor import ReplicaProcess
+from perceiver_tpu.obs import events as events_mod
+
+__all__ = ["GroupCutoverError", "GroupReplicaHandle", "ReplicaGroup"]
+
+
+class GroupCutoverError(RuntimeError):
+    """A two-phase group cutover failed (after member-level rollback).
+
+    ``cause`` is the member-side failure; ``rolled_back`` lists member
+    ids restored to the previous version; ``rollback_failed`` lists
+    any left on the new version (the supervisor's group re-form will
+    converge them back onto the store's CURRENT)."""
+
+    def __init__(self, message: str, cause: Exception,
+                 rolled_back, rollback_failed):
+        super().__init__(message)
+        self.cause = cause
+        self.rolled_back = list(rolled_back)
+        self.rollback_failed = list(rollback_failed)
+
+
+class GroupReplicaHandle:
+    """Router/rollout-facing view of one process group.
+
+    Dispatch goes to the lead (member 0) — its death surfaces as the
+    same transport :class:`RpcError` a dead single-process replica
+    produces, so the router's ejection/retry path needs no changes.
+    Control ops that must hold group-wide (status, the two-phase
+    cutover, shutdown) fan out to every member.
+    """
+
+    def __init__(self, members: List, *, rid: str):
+        if not members:
+            raise ValueError("a replica group needs at least one member")
+        self._members = list(members)
+        self.rid = rid
+
+    def _member_id(self, rank: int) -> str:
+        return f"{self.rid}.m{rank}"
+
+    # -- traffic ----------------------------------------------------------
+
+    def dispatch(self, arrays: dict,
+                 trace: Optional[dict] = None) -> dict:
+        return self._members[0].dispatch(arrays, trace)
+
+    # -- control ----------------------------------------------------------
+
+    def status(self) -> dict:
+        """Lead's status + per-member detail. ``ready`` only when EVERY
+        member is (a group missing a member cannot serve a collective);
+        ``version_skew`` flags the torn state the cutover exists to
+        prevent."""
+        members: Dict[str, dict] = {}
+        versions = set()
+        ready = True
+        lead: dict = {"health": "UNAVAILABLE"}
+        for rank, handle in enumerate(self._members):
+            try:
+                st = handle.status()
+            except (RpcError, OSError):
+                st = {"health": "UNAVAILABLE", "ready": False}
+            if rank == 0:
+                lead = st
+            members[f"m{rank}"] = {
+                "health": st.get("health"),
+                "ready": bool(st.get("ready")),
+                "version": st.get("version"),
+                "staged": st.get("staged"),
+            }
+            ready = ready and bool(st.get("ready"))
+            versions.add(st.get("version"))
+        out = dict(lead)
+        out["ready"] = ready
+        out["group_size"] = len(self._members)
+        out["members"] = members
+        out["version_skew"] = len(versions) > 1
+        return out
+
+    def update_version(self, version: str) -> dict:
+        """Two-phase cutover: stage everywhere, then commit everywhere.
+
+        CURRENT is the caller's to move (``rolling_update`` does, only
+        after every replica acks) — this method's contract is that the
+        GROUP is never left torn: either all members serve ``version``
+        on return, or all members serve the previous version and a
+        typed :class:`GroupCutoverError` reports why (modulo members
+        whose rollback itself failed, reported in
+        ``rollback_failed`` — the group re-form converges those)."""
+        previous = self._members[0].status().get("version")
+        # phase 1 — stage: verified load into member memory, traffic
+        # untouched; any failure aborts with nothing committed
+        staged: List[int] = []
+        try:
+            for rank, handle in enumerate(self._members):
+                events_mod.emit("cutover_stage",
+                                replica=self._member_id(rank),
+                                version=version)
+                handle.stage_version(version)
+                staged.append(rank)
+        except Exception as cause:
+            self._abort(staged)
+            raise GroupCutoverError(
+                f"stage of {version!r} failed on member "
+                f"{self._member_id(len(staged))} "
+                f"({type(cause).__name__}: {cause}); nothing committed",
+                cause, rolled_back=[], rollback_failed=[]) from cause
+        # phase 2 — commit: each member quiesces and swaps atomically;
+        # a failure here means some members already serve the new
+        # version → roll them back before reporting
+        committed: List[int] = []
+        for rank, handle in enumerate(self._members):
+            try:
+                handle.commit_version(version)
+            except Exception as cause:
+                events_mod.emit("cutover_rollback", replica=self.rid,
+                                version=previous or "")
+                self._abort(range(rank + 1, len(self._members)))
+                rolled_back, failed = self._rollback(committed, previous)
+                raise GroupCutoverError(
+                    f"commit of {version!r} failed on member "
+                    f"{self._member_id(rank)} "
+                    f"({type(cause).__name__}: {cause}); rolled back "
+                    f"{rolled_back or 'nothing'}"
+                    + (f", rollback FAILED for {failed}" if failed
+                       else ""),
+                    cause, rolled_back, failed) from cause
+            committed.append(rank)
+            events_mod.emit("cutover_ack",
+                            replica=self._member_id(rank),
+                            version=version)
+        return {"version": version}
+
+    def _abort(self, ranks) -> None:
+        """Best-effort drop of staged-but-uncommitted params."""
+        for rank in ranks:
+            try:
+                self._members[rank].abort_version()
+            except (RpcError, OSError):
+                pass  # dead member holds nothing worth dropping
+
+    def _rollback(self, committed: List[int],
+                  previous: Optional[str]):
+        """Re-run stage+commit of ``previous`` on already-committed
+        members. Returns (rolled_back_ids, failed_ids)."""
+        rolled_back, failed = [], []
+        for rank in committed:
+            mid = self._member_id(rank)
+            if previous is None:
+                failed.append(mid)
+                continue
+            try:
+                self._members[rank].stage_version(previous)
+                self._members[rank].commit_version(previous)
+                rolled_back.append(mid)
+            except Exception:  # noqa: BLE001 — collected, reported
+                failed.append(mid)
+        return rolled_back, failed
+
+    def metrics_text(self) -> str:
+        return self._members[0].metrics_text()
+
+    def shutdown(self) -> None:
+        for handle in self._members:
+            try:
+                handle.shutdown()
+            except (RpcError, OSError):
+                pass  # already dead — group shutdown is best-effort
+
+    def close(self) -> None:
+        for handle in self._members:
+            handle.close()
+
+
+class ReplicaGroup:
+    """N member processes presented to the fleet Supervisor as ONE
+    replica (spec key ``group_size``; members get the same spec minus
+    it, with rids ``<rid>.m<rank>``).
+
+    ``per_member_env`` keys are member names (``"m1"``) — the
+    supervisor routes its ``per_replica_env["<rid>.m<rank>"]`` entries
+    here, which is how the chaos harness arms a fault on ONE host of
+    a group.
+    """
+
+    def __init__(self, rid: str, spec: dict, workdir: str, *,
+                 ready_timeout_s: float = 120.0,
+                 env: Optional[dict] = None,
+                 dispatch_timeout_s: float = 15.0,
+                 per_member_env: Optional[Dict[str, dict]] = None,
+                 generation: int = 0):
+        self.rid = rid
+        self.generation = generation
+        group_size = int(spec.get("group_size", 1))
+        if group_size < 1:
+            raise ValueError(f"group_size must be >= 1, got {group_size}")
+        if generation > 0:
+            # the supervisor is respawning this slot after a member
+            # death — a whole-group re-form, not a process restart
+            events_mod.emit("group_reform", group=rid,
+                            generation=generation)
+        member_spec = {k: v for k, v in spec.items()
+                       if k != "group_size"}
+        self.members: List[ReplicaProcess] = []
+        self._dead_code: Optional[int] = None
+        try:
+            for rank in range(group_size):
+                member_env = dict(env if env is not None
+                                  else os.environ)
+                member_env.update(
+                    (per_member_env or {}).get(f"m{rank}", {}))
+                member = ReplicaProcess(
+                    f"{rid}.m{rank}", member_spec, workdir,
+                    ready_timeout_s=ready_timeout_s,
+                    dispatch_timeout_s=dispatch_timeout_s,
+                    env=member_env)
+                self.members.append(member)
+                events_mod.emit("host_join", group=rid, rank=rank,
+                                pid=member.pid)
+        except Exception:
+            for member in self.members:
+                member.kill()
+            raise
+        self.handle = GroupReplicaHandle(
+            [m.handle for m in self.members], rid=rid)
+
+    # -- ReplicaProcess protocol ------------------------------------------
+
+    def poll(self) -> Optional[int]:
+        """First member death marks the WHOLE group dead (survivors
+        cannot make progress on a torn collective) — survivors are
+        killed here so the supervisor's death path re-forms a complete
+        group rather than adopting a zombie quorum."""
+        if self._dead_code is not None:
+            return self._dead_code
+        for rank, member in enumerate(self.members):
+            code = member.poll()
+            if code is not None:
+                events_mod.emit("host_leave", group=self.rid,
+                                rank=rank, exit_code=code)
+                for other in self.members:
+                    if other.poll() is None:
+                        other.kill()
+                self._dead_code = code
+                return code
+        return None
+
+    @property
+    def pid(self) -> int:
+        return self.members[0].pid
+
+    def kill(self) -> None:
+        for member in self.members:
+            member.kill()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        for member in self.members:
+            member.stop(timeout=timeout)

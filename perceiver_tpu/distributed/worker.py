@@ -1,0 +1,147 @@
+"""Group-member entrypoint: one training (or rendezvous) process.
+
+``python -m perceiver_tpu.distributed.worker --spec spec.json --rank R
+--nproc N --coordinator H:P --generation G`` is what
+:class:`~perceiver_tpu.distributed.group.GroupSupervisor` spawns per
+member. The spec file is the job description; rank / coordinator /
+generation are the supervisor's per-spawn slot assignment.
+
+Two modes (``spec["mode"]``):
+
+- ``bootstrap_only`` — rendezvous with the coordinator, assert the
+  group formed (``jax.process_count() == nproc``), exit 0. No
+  collectives are issued, so this runs on CPU backends whose cluster
+  formation works but whose cross-process computations don't (the
+  probe in ``tests/conftest.py``) — it is the chaos harness's
+  coordinator-loss scenario.
+- ``train`` — run the tiny-preset trainer with the full resilience
+  stack armed: sha256-verified anchors every
+  ``guard_anchor_every_n_steps`` into the generation's anchor
+  directory, and on generation > 0 resume from the NEWEST anchor any
+  previous generation left (``resume_step_replay`` repositions the
+  epoch-seeded stream at the restored step, so the resumed loss curve
+  is bitwise-identical to an uninterrupted run — the
+  ``dist_kill_train_host`` chaos assertion).
+
+Exit codes: 0 success; 77 typed rendezvous timeout (the supervisor
+and the chaos harness match on it); anything else is a crash the
+supervisor answers with a group re-form.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+RENDEZVOUS_EXIT = 77
+
+
+def _newest_anchor_dir(anchors_root: str, generation: int) -> str:
+    """Newest previous generation's anchor dir that holds at least one
+    committed step ('' if none) — the resume source after a re-form."""
+    best = ""
+    for g in range(generation):
+        d = os.path.join(anchors_root, f"g{g}")
+        if os.path.isdir(d) and any(s.isdigit() for s in os.listdir(d)):
+            best = d
+    return best
+
+
+def _run_train(spec: dict, args, workdir: str) -> dict:
+    from perceiver_tpu.data import MNISTDataModule
+    from perceiver_tpu.training import Trainer, TrainerConfig
+    from perceiver_tpu.tasks import ImageClassifierTask
+
+    task = ImageClassifierTask(
+        image_shape=(28, 28, 1), num_classes=10, num_frequency_bands=4,
+        num_latents=4, num_latent_channels=16, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_decoder_cross_attention_heads=1)
+    dm = MNISTDataModule(
+        data_dir=os.path.join(workdir, "data"),
+        batch_size=int(spec.get("batch_size", 16)),
+        synthetic_train_size=int(spec.get("train_size", 96)),
+        synthetic_test_size=32)
+    anchors_root = os.path.join(workdir, "anchors")
+    resume = _newest_anchor_dir(anchors_root, args.generation)
+    cfg = TrainerConfig(
+        max_steps=int(spec.get("max_steps", 6)), max_epochs=8,
+        num_sanity_val_steps=0, log_every_n_steps=1,
+        default_root_dir=os.path.join(workdir,
+                                      f"logs_g{args.generation}"),
+        enable_checkpointing=False,
+        prefetch_batches=int(spec.get("prefetch_batches", 0)),
+        nonfinite_policy="skip",
+        guard_anchor_every_n_steps=int(
+            spec.get("guard_anchor_every_n_steps", 2)),
+        guard_anchor_dir=os.path.join(anchors_root,
+                                      f"g{args.generation}"),
+        resume_from_checkpoint=resume or None,
+        resume_step_replay=True,
+        telemetry_dir=os.path.join(workdir, "telemetry",
+                                   f"g{args.generation}"),
+        seed=int(spec.get("seed", 42)))
+    trainer = Trainer(task, dm, cfg,
+                      optimizer_init={"class_path": "AdamW",
+                                      "init_args": {"lr": 1e-3}})
+    state = trainer.fit()
+    return {"final_step": int(state.step),
+            "resumed_from": resume,
+            "generation": args.generation}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--spec", required=True)
+    parser.add_argument("--rank", type=int, required=True)
+    parser.add_argument("--nproc", type=int, required=True)
+    parser.add_argument("--coordinator", required=True)
+    parser.add_argument("--generation", type=int, default=0)
+    args = parser.parse_args(argv)
+    with open(args.spec) as f:
+        spec = json.load(f)
+    # zero-egress default: synthetic datasets, never a download stall
+    os.environ.setdefault("PERCEIVER_TPU_OFFLINE", "1")
+
+    from perceiver_tpu.distributed import bootstrap
+
+    config = bootstrap.DistributedConfig(
+        coordinator_address=args.coordinator,
+        num_processes=args.nproc, process_id=args.rank,
+        rendezvous_timeout_s=float(
+            spec.get("rendezvous_timeout_s", 60.0)))
+    try:
+        bootstrap.initialize(config)
+    except bootstrap.RendezvousTimeout as e:
+        print(f"RENDEZVOUS_TIMEOUT {e}", file=sys.stderr, flush=True)
+        # hard exit: the abandoned rendezvous thread's gRPC client
+        # LOG(FATAL)s (SIGABRT) when its own deadline expires during
+        # interpreter teardown, clobbering the typed exit code — skip
+        # teardown entirely (the timeout event is already on disk)
+        os._exit(RENDEZVOUS_EXIT)
+
+    import jax
+
+    workdir = spec.get("workdir") or os.path.dirname(
+        os.path.abspath(args.spec))
+    if spec.get("mode") == "bootstrap_only":
+        # cluster must actually have formed — process_count is served
+        # by the coordinator, no collective involved
+        assert jax.process_count() == args.nproc, \
+            (jax.process_count(), args.nproc)
+        result = {"process_count": jax.process_count(),
+                  "process_id": jax.process_index()}
+    else:
+        result = _run_train(spec, args, workdir)
+    out = os.path.join(
+        workdir, f"result.g{args.generation}.r{args.rank}.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"DONE rank={args.rank} {json.dumps(result)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

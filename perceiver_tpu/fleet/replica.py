@@ -16,9 +16,16 @@ RPC ops (see ``fleet/rpc.py`` for the envelope):
                     ``packed_buckets`` enables it) — the router and
                     RPC envelope are payload-agnostic, so packed and
                     rectangular replicas interchange freely
-``status``          health/readiness, in-flight, version, compile
-                    count, breaker summary, fired fault counts
+``status``          health/readiness, in-flight, version, staged
+                    version, compile count, breaker summary, fired
+                    fault counts
 ``update_version``  the rolling-update cutover (below)
+``stage_version``   phase 1 of the group two-phase cutover: verified
+                    load into memory, traffic untouched
+``commit_version``  phase 2: quiesce and swap to the staged params
+                    (``distributed/serving_group.py`` drives these —
+                    a group swaps only after EVERY member staged)
+``abort_version``   drop a staged version (stage-phase failure)
 ``metrics``         Prometheus text exposition
 ``ping``            liveness no-op
 ``shutdown``        clean exit
@@ -34,9 +41,11 @@ served by a mid-swap replica**: every dispatch runs entirely on the
 old params or entirely on the new.
 
 Chaos seams: ``replica.stall`` and ``replica.crash``
-(``resilience/faults.py``) fire in the dispatch handler, inherited by
-this process through the ``PERCEIVER_FAULTS`` env var exactly like
-every other chaos child.
+(``resilience/faults.py``) fire in the dispatch handler, and
+``replica.commit_crash`` at ``commit_version`` entry — the
+killed-between-stage-and-swap window the ``dist_cutover_kill``
+scenario exercises — all inherited by this process through the
+``PERCEIVER_FAULTS`` env var exactly like every other chaos child.
 """
 
 from __future__ import annotations
@@ -76,6 +85,8 @@ class ReplicaServer:
         self._idle = threading.Condition(self._lock)
         self._inflight = 0
         self._swapping = False
+        # (version, params) held for the two-phase group cutover
+        self._staged: Optional[tuple] = None
         self._stop = threading.Event()
         self._compile_events: list = []
         self._listener_registered = False
@@ -141,6 +152,12 @@ class ReplicaServer:
             return self._status()
         if op == "update_version":
             return self._update_version(request["version"])
+        if op == "stage_version":
+            return self._stage_version(request["version"])
+        if op == "commit_version":
+            return self._commit_version(request["version"])
+        if op == "abort_version":
+            return self._abort_version()
         if op == "metrics":
             return self.engine.metrics.render()
         if op == "ping":
@@ -197,12 +214,14 @@ class ReplicaServer:
         with self._lock:
             inflight = self._inflight
             swapping = self._swapping
+            staged = self._staged[0] if self._staged else None
         return {
             "health": self.engine.health.state.name,
             "ready": self.engine.ready and not swapping,
             "inflight": inflight,
             "swapping": swapping,
             "version": self.version,
+            "staged": staged,
             "compile_events": (len(self._compile_events)
                                if self._compile_events is not None else -1),
             "breaker_open_buckets": (int(open_buckets.value)
@@ -233,6 +252,58 @@ class ReplicaServer:
             with self._lock:
                 self._swapping = False
         return {"version": self.version}
+
+    def _stage_version(self, version: str) -> dict:
+        """Two-phase cutover, phase 1: verified load of ``version``
+        into memory. Serving is untouched — the staged tree sits
+        beside the live one until commit or abort. Idempotent:
+        re-staging replaces the previous staged tree."""
+        if self.store is None:
+            raise ValueError("replica has no params version store")
+        params = self.store.load(version, self.engine._params_src)
+        with self._lock:
+            self._staged = (version, params)
+        return {"staged": version}
+
+    def _commit_version(self, version: str) -> dict:
+        """Phase 2: quiesce and swap to the STAGED params. The swap
+        itself is the same atomic quiesce → ``update_params`` →
+        readmit as ``update_version`` — a dispatch racing the commit
+        gets the typed ``Unavailable`` retry, never torn params."""
+        # the killed-between-stage-and-swap chaos window: a SIGKILL
+        # here leaves this member staged-but-uncommitted while its
+        # siblings may already serve the new version — the group
+        # handle's rollback path owns the cleanup
+        faults.maybe_kill("replica.commit_crash")
+        with self._lock:
+            if self._swapping:
+                raise Unavailable("updating", retry_after_s=0.1)
+            if self._staged is None or self._staged[0] != version:
+                have = self._staged[0] if self._staged else None
+                raise ValueError(
+                    f"commit of {version!r} without a matching stage "
+                    f"(staged: {have!r}) — the two-phase protocol "
+                    f"requires stage_version first")
+            self._swapping = True
+        try:
+            with self._lock:
+                while self._inflight > 0:
+                    self._idle.wait(0.05)
+                version, params = self._staged
+                self._staged = None
+            self.engine.update_params(params)
+            self.version = version
+        finally:
+            with self._lock:
+                self._swapping = False
+        return {"version": self.version}
+
+    def _abort_version(self) -> dict:
+        """Drop a staged version (stage-phase failure on a sibling)."""
+        with self._lock:
+            staged = self._staged
+            self._staged = None
+        return {"aborted": staged[0] if staged else None}
 
     # -- lifecycle --------------------------------------------------------
 

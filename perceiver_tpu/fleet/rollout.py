@@ -24,6 +24,14 @@ the same drain/cutover steps, the store's CURRENT pointer is left
 untouched, and a typed :class:`RolloutAborted` reports both the cause
 and the rollback outcome. Mid-rollout checkpoint corruption is chaos-
 gated (``scripts/chaos.py --fleet``, scenario ``fleet_rollout_corrupt``).
+
+Process-group replicas (``distributed/serving_group.py``) plug in at
+step 3 unchanged: the group handle's ``update_version`` IS the
+two-phase stage-then-commit cutover, whose own member-level rollback
+guarantees a group is never left torn; its typed
+``GroupCutoverError`` lands in the same ``except`` below, so a member
+killed between stage and swap rolls the whole FLEET back with CURRENT
+untouched (chaos scenario ``dist_cutover_kill``).
 """
 
 from __future__ import annotations

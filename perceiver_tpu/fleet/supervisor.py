@@ -62,6 +62,19 @@ class RpcReplicaHandle:
         # dispatch budget, not the control budget
         return self._client.call("update_version", version=version)
 
+    def stage_version(self, version: str) -> dict:
+        # phase 1 of the group two-phase cutover: a verified load is
+        # disk-bound, so it gets the dispatch budget too
+        return self._client.call("stage_version", version=version)
+
+    def commit_version(self, version: str) -> dict:
+        # phase 2: quiesces like update_version — dispatch budget
+        return self._client.call("commit_version", version=version)
+
+    def abort_version(self) -> dict:
+        return self._client.call("abort_version",
+                                 timeout=self._control_timeout)
+
     def metrics_text(self) -> str:
         return self._client.call("metrics", timeout=self._control_timeout)
 
@@ -202,6 +215,25 @@ class Supervisor:
     def _spawn_proc(self, rid: str) -> ReplicaProcess:
         env = dict(self._env if self._env is not None else os.environ)
         env.update(self._per_replica_env.get(rid, {}))
+        if int(self.spec.get("group_size", 1)) > 1:
+            # a multi-host replica: N member processes supervised as
+            # ONE slot (lazy import — serving_group imports this
+            # module for ReplicaProcess). per_replica_env keys of the
+            # form "<rid>.m<rank>" target a single member, which is
+            # how chaos arms a fault on one host of a group.
+            from perceiver_tpu.distributed.serving_group import ReplicaGroup
+
+            prefix = f"{rid}."
+            per_member = {k[len(prefix):]: v
+                          for k, v in self._per_replica_env.items()
+                          if k.startswith(prefix)}
+            with self._lock:
+                generation = self._restarts.get(rid, 0)
+            return ReplicaGroup(
+                rid, self.spec, self.workdir,
+                ready_timeout_s=self.ready_timeout_s,
+                dispatch_timeout_s=self.dispatch_timeout_s, env=env,
+                per_member_env=per_member, generation=generation)
         return ReplicaProcess(
             rid, self.spec, self.workdir,
             ready_timeout_s=self.ready_timeout_s,

@@ -60,6 +60,15 @@ SCHEMA: Dict[str, Tuple[str, ...]] = {
     "preempt_checkpoint": ("step",),
     "train_step": ("step", "loss"),
     "profile_capture": ("dir",),
+    # distributed (multi-host groups; docs/RESILIENCE.md "Multi-host")
+    "host_join": ("group", "rank"),
+    "host_leave": ("group", "rank"),
+    "group_reform": ("group", "generation"),
+    "rendezvous_timeout": ("coordinator",),
+    # two-phase cutover on process-group replicas (docs/SERVING.md)
+    "cutover_stage": ("replica", "version"),
+    "cutover_ack": ("replica", "version"),
+    "cutover_rollback": ("replica", "version"),
 }
 
 

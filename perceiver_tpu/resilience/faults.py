@@ -35,12 +35,18 @@ Known points (arming an unknown name is a loud ``ValueError``):
 ``loader.stall``         sleep ``value`` seconds in the producer (default 30)
 ``train.nonfinite``      poison one train step's batch to NaN (per step)
 ``train.preempt``        report a pending preemption to the trainer
+``train.kill``           SIGKILL a training process at the dispatch
+                         boundary (the crash-of-one-host window the
+                         group supervisor recovers from)
 ``ckpt.truncate``        truncate a checkpoint blob after its manifest
 ``ckpt.kill_during_save``  SIGKILL this process mid-checkpoint-save
 ``serve.dispatch``       raise inside the serving engine's dispatch
 ``replica.stall``        sleep ``value`` seconds in a fleet replica's
                          dispatch handler (default 30)
 ``replica.crash``        SIGKILL a fleet replica mid-dispatch
+``replica.commit_crash``  SIGKILL a group member at ``commit_version``
+                         entry — between stage and swap of the
+                         two-phase cutover
 =======================  ====================================================
 """
 
@@ -58,11 +64,13 @@ POINTS = frozenset({
     "loader.stall",
     "train.nonfinite",
     "train.preempt",
+    "train.kill",
     "ckpt.truncate",
     "ckpt.kill_during_save",
     "serve.dispatch",
     "replica.stall",
     "replica.crash",
+    "replica.commit_crash",
 })
 
 ENV_VAR = "PERCEIVER_FAULTS"

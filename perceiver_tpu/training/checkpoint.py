@@ -143,17 +143,25 @@ class CheckpointHook:
 
     def __init__(self, directory: str, max_to_keep: int = 1,
                  monitor: str = "val_loss", mode: str = "min",
-                 hparams: Optional[dict] = None):
+                 hparams: Optional[dict] = None,
+                 enable_async: bool = True):
         self.directory = _abs(directory)
         self.monitor = monitor
         best_fn = (lambda m: m[monitor]) if monitor else None
+        # enable_async=False forces the whole write (and the manifest
+        # seal) to complete inside save(). Guard anchors NEED this: the
+        # train step donates the TrainState, and on backends where
+        # donation reuses the host buffer in place (CPU) an async save
+        # serializes whatever the buffer holds when the writer drains —
+        # a LATER step's state under the anchor's step label.
+        self._async = enable_async
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 best_fn=best_fn,
                 best_mode=mode,
-                enable_async_checkpointing=True))
+                enable_async_checkpointing=enable_async))
         # step whose async save has been issued but whose integrity
         # manifest is not written yet (sealed on the next save/wait)
         self._pending_manifest: Optional[int] = None
@@ -174,6 +182,10 @@ class CheckpointHook:
         # async write/commit is in flight (tests/test_resilience.py)
         faults.maybe_kill("ckpt.kill_during_save")
         self._pending_manifest = step
+        if not self._async:
+            # synchronous mode: the write already committed — seal it
+            # now so the newest anchor is always sha256-verified
+            self._finalize_pending()
 
     def _finalize_pending(self) -> None:
         """Seal the previous async save with its integrity manifest
@@ -220,6 +232,13 @@ class CheckpointHook:
                 f"every checkpoint step in {self.directory} "
                 f"({steps}) fails manifest verification")
         return None
+
+    def newest_restorable_step(self) -> Optional[int]:
+        """Public face of the verified-newest-step scan: the step a
+        ``restore_latest`` would load, or ``None``. The multi-host
+        chaos harness uses it to assert a re-formed group resumed from
+        exactly the anchor the killed generation left behind."""
+        return self._newest_restorable_step()
 
     def restore_latest(self, template_state: TrainState
                        ) -> Optional[TrainState]:

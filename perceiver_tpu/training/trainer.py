@@ -94,6 +94,21 @@ class TrainerConfig:
     # extra last-good anchor saves every N steps under the "skip"
     # policy (0 = anchors at fit start and epoch starts only)
     guard_anchor_every_n_steps: int = 0
+    # where the guard's anchor checkpoints live (default
+    # <log_dir>/checkpoints-guard). A multi-host group supervisor
+    # points every generation of a re-formed group at ONE shared
+    # directory so the respawned run finds the previous run's newest
+    # verified anchor (distributed/worker.py)
+    guard_anchor_dir: Optional[str] = None
+    # position the data stream at the restored step after a resume:
+    # the loader is epoch-seeded, so epoch = step // len(loader) and
+    # replaying step % len(loader) batches reproduces the exact
+    # position the checkpoint was taken at — the resumed loss curve is
+    # bitwise-identical to an uninterrupted run (the crash-of-one-host
+    # recovery contract, chaos scenario dist_kill_train_host). Off by
+    # default: single-host resumes historically continue at the NEXT
+    # epoch boundary
+    resume_step_replay: bool = False
     # supervised input pipeline: transient loader failures restart the
     # prefetch producer with exponential backoff, bounded by this
     # poison-pill budget (0 = die on first error); persistent failures
@@ -627,9 +642,13 @@ class Trainer:
                 streak_to_rewind=cfg.nonfinite_streak,
                 max_rewinds=cfg.nonfinite_max_rewinds)
             if self._guard_policy == guard_mod.SKIP:
+                # synchronous: the anchor must snapshot the state AT
+                # this step — an async save of donated buffers can
+                # serialize a later step's contents under this label
                 self._guard_ckpt = CheckpointHook(
-                    os.path.join(self.log_dir, "checkpoints-guard"),
-                    max_to_keep=1, monitor="")
+                    cfg.guard_anchor_dir
+                    or os.path.join(self.log_dir, "checkpoints-guard"),
+                    max_to_keep=1, monitor="", enable_async=False)
 
         state = self._build_state()
         self._make_steps()
@@ -709,6 +728,17 @@ class Trainer:
         metrics = None
         epoch = 0
         replay_batches = 0  # rewind reposition within the next epoch
+        if cfg.resume_step_replay and self.global_step > 0:
+            # reposition the epoch-seeded stream at the restored step
+            # (same mechanics as a guard rewind): global_step counts
+            # one batch per step, so step // per_epoch names the epoch
+            # and step % per_epoch the batches already consumed in it
+            per_epoch = len(train_loader)
+            if limit_train is not None:
+                per_epoch = min(per_epoch, limit_train)
+            if per_epoch > 0:
+                epoch = self.global_step // per_epoch
+                replay_batches = self.global_step % per_epoch
         while epoch < max_epochs:
             self.current_epoch = epoch
             train_loader.set_epoch(epoch)
@@ -812,6 +842,12 @@ class Trainer:
                 batches_done += len(group)
                 samples_since += batch_size
                 steps_since += len(group)
+                # crash-of-one-host chaos window: a SIGKILL at the
+                # dispatch boundary — after steps are consumed, before
+                # the guard syncs or anchors — is the worst-case point
+                # the anchor/replay recovery must absorb
+                # (distributed/group.py re-forms; dist_kill_train_host)
+                faults.maybe_kill("train.kill")
 
                 if self._guard is not None:
                     # per-dispatch host sync of the per-step losses:

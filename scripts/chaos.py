@@ -21,7 +21,10 @@ Emits one ``bench.py``-format JSON line per scenario::
 plus a ``chaos_matrix`` summary line; exits non-zero iff any scenario
 failed. ``--fast`` runs the tier-1 subset
 (``tests/test_chaos.py`` mirrors the ``check.py`` subprocess-gate
-pattern)::
+pattern); ``--fleet``/``--fleet-fast`` run the multi-process fleet
+matrix, and ``--dist``/``--dist-fast`` the multi-host matrix
+(process-group training recovery, coordinator loss, group-replica
+failover, two-phase cutover kill)::
 
     JAX_PLATFORMS=cpu python scripts/chaos.py --fast
 """
@@ -350,14 +353,20 @@ def _fleet_spec(store) -> dict:
 
 def _start_fleet(tmp: str, store, *, replicas: int,
                  per_replica_env=None, dispatch_timeout_s: float = 15.0,
-                 max_restarts: int = 3):
+                 max_restarts: int = 3, group_size: int = 1):
     from perceiver_tpu.fleet import Fleet
 
     # replicas share one persistent exec cache: the first spin-up
     # compiles and stores, the rest deserialize (zero-compile)
     os.environ.setdefault("PERCEIVER_EXEC_CACHE",
                           os.path.join(tmp, "exec_cache"))
-    return Fleet(_fleet_spec(store), os.path.join(tmp, "fleet"),
+    spec = _fleet_spec(store)
+    if group_size > 1:
+        # each fleet replica becomes a process GROUP of this many
+        # members (distributed/serving_group.py); per_replica_env keys
+        # of the form "r0.m1" then arm a fault on ONE member
+        spec["group_size"] = group_size
+    return Fleet(spec, os.path.join(tmp, "fleet"),
                  replicas=replicas, max_restarts=max_restarts,
                  dispatch_timeout_s=dispatch_timeout_s,
                  per_replica_env=per_replica_env)
@@ -645,6 +654,350 @@ def scenario_fleet_rollout(tmp: str) -> dict:
             "faults_fired": {}}
 
 
+# --- multi-host scenarios (docs/RESILIENCE.md / SERVING.md "Multi-host") ----
+#
+# The dist matrix proves the fault-tolerant multi-host story end to
+# end on one machine: process-group training recovery with a
+# bitwise-identical stitched loss curve, coordinator loss as a typed
+# timebox (never a hang), and sharded group replicas that survive
+# losing one host — both under traffic and mid-cutover. Cross-process
+# COLLECTIVES are not required (the CPU-backend probe in
+# tests/conftest.py gates those); cluster *formation* is pure gRPC and
+# runs everywhere, which is exactly what dist_coordinator_loss spans.
+
+
+def _worker_argv(spec_path: str):
+    """argv factory for ``perceiver_tpu.distributed.worker`` members,
+    in the shape ``GroupSupervisor`` expects."""
+
+    def spawn_argv(rank, nproc, coordinator, generation):
+        return [sys.executable, "-m", "perceiver_tpu.distributed.worker",
+                "--spec", spec_path, "--rank", str(rank),
+                "--nproc", str(nproc), "--coordinator", coordinator,
+                "--generation", str(generation)]
+
+    return spawn_argv
+
+
+def _telemetry_losses(workdir: str, generation: int) -> dict:
+    """step -> loss float from one generation's telemetry JSONL (JSON
+    round-trips the float bits, so == below means bitwise equal)."""
+    path = os.path.join(workdir, "telemetry", f"g{generation}",
+                        "telemetry.jsonl")
+    losses = {}
+    with open(path) as f:
+        for line in f:
+            ev = json.loads(line)
+            if ev.get("type") == "train_step":
+                losses[int(ev["step"])] = ev["loss"]
+    return losses
+
+
+def scenario_dist_coordinator_loss(tmp: str) -> dict:
+    """Coordinator dead at bootstrap: every member exits with the TYPED
+    rendezvous timeout (exit 77 + ``rendezvous_timeout`` event) inside
+    the timebox instead of wedging forever in the gRPC retry loop; a
+    clean retry against a live coordinator then forms a real 2-process
+    cluster (rendezvous needs no collectives, so this half runs on any
+    CPU backend)."""
+    from perceiver_tpu.distributed.group import free_port
+    from perceiver_tpu.distributed.worker import RENDEZVOUS_EXIT
+
+    workdir = os.path.join(tmp, "coord")
+    events_dir = os.path.join(tmp, "events")
+    os.makedirs(workdir, exist_ok=True)
+    os.makedirs(events_dir, exist_ok=True)
+    spec_path = os.path.join(workdir, "spec.json")
+    timeout_s = 4.0
+    with open(spec_path, "w") as f:
+        json.dump({"mode": "bootstrap_only", "workdir": workdir,
+                   "rendezvous_timeout_s": timeout_s}, f)
+    env = dict(os.environ, PERCEIVER_TPU_OFFLINE="1",
+               PERCEIVER_EVENT_LOG=events_dir)
+    env.pop("PERCEIVER_FAULTS", None)
+    argv = _worker_argv(spec_path)
+
+    def spawn(ranks, nproc, coordinator, generation):
+        return [subprocess.Popen(
+            argv(rank, nproc, coordinator, generation), env=env,
+            cwd=_REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True) for rank in ranks]
+
+    # phase 1 — the COORDINATOR host (rank 0, which would serve the
+    # rendezvous endpoint) is dead: the surviving members dial an
+    # address nobody will ever listen on and must fail TYPED within
+    # the timebox, never hang in the gRPC retry loop
+    dead = f"127.0.0.1:{free_port()}"
+    t0 = time.monotonic()
+    procs = spawn((1, 2), 3, dead, 0)
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    phase1_s = time.monotonic() - t0
+    codes = [p.returncode for p in procs]
+    assert codes == [RENDEZVOUS_EXIT] * 2, (codes, outs)
+    assert all("RENDEZVOUS_TIMEOUT" in o for o in outs), outs
+    assert phase1_s < 180, phase1_s  # timeboxed, not a hang
+    timeout_events = []
+    for name in sorted(os.listdir(events_dir)):
+        with open(os.path.join(events_dir, name)) as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("type") == "rendezvous_timeout":
+                    timeout_events.append(ev)
+    assert len(timeout_events) >= 2, timeout_events
+    assert all(e["coordinator"] == dead for e in timeout_events), \
+        timeout_events
+
+    # phase 2 — clean retry against a LIVE coordinator (rank 0 hosts
+    # the coordinator service): the same binary, a fresh generation,
+    # and the cluster actually forms
+    live = f"127.0.0.1:{free_port()}"
+    procs = spawn((0, 1), 2, live, 1)
+    outs2 = [p.communicate(timeout=240)[0] for p in procs]
+    assert [p.returncode for p in procs] == [0, 0], outs2
+    results = []
+    for rank in range(2):
+        with open(os.path.join(workdir,
+                               f"result.g1.r{rank}.json")) as f:
+            results.append(json.load(f))
+    assert all(r["process_count"] == 2 for r in results), results
+    return {"phase1_exit_codes": codes,
+            "phase1_wall_s": round(phase1_s, 2),
+            "rendezvous_timeout_events": len(timeout_events),
+            "retry_process_count": results[0]["process_count"],
+            "faults_fired": {"coordinator.dead": 1}}
+
+
+def scenario_dist_kill_train_host(tmp: str) -> dict:
+    """SIGKILL the training host at the dispatch boundary mid-epoch
+    (``train.kill``): the group supervisor tears the group down and
+    re-forms it as generation 1, which restores the newest
+    sha256-verified anchor generation 0 left and replays the
+    epoch-seeded stream to that exact position — the stitched per-step
+    loss trace is BITWISE-identical to an uninterrupted control run."""
+    from perceiver_tpu.distributed.group import GroupSupervisor
+    from perceiver_tpu.obs import events as events_mod
+    from perceiver_tpu.training.checkpoint import CheckpointHook
+
+    # control and victim generations share one compiled-step cache
+    os.environ.setdefault("PERCEIVER_EXEC_CACHE",
+                          os.path.join(tmp, "exec_cache"))
+
+    def write_spec(workdir):
+        os.makedirs(workdir, exist_ok=True)
+        spec_path = os.path.join(workdir, "spec.json")
+        with open(spec_path, "w") as f:
+            json.dump({"mode": "train", "workdir": workdir,
+                       "max_steps": TARGET_STEP,
+                       "guard_anchor_every_n_steps": 2,
+                       "seed": 42}, f)
+        return spec_path
+
+    # control: one uninterrupted run -> the reference loss trace
+    control_dir = os.path.join(tmp, "control")
+    env = dict(os.environ, PERCEIVER_TPU_OFFLINE="1")
+    env.pop("PERCEIVER_FAULTS", None)
+    argv = _worker_argv(write_spec(control_dir))
+    proc = subprocess.run(argv(0, 1, "127.0.0.1:0", 0), env=env,
+                          cwd=_REPO, capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, (proc.stdout[-3000:],
+                                  proc.stderr[-3000:])
+    control = _telemetry_losses(control_dir, 0)
+    assert sorted(control) == list(range(1, TARGET_STEP + 1)), control
+
+    # victim: the same job under the group supervisor, with the kill
+    # armed in generation 0 ONLY (the member_env seam) so the
+    # re-formed generation runs clean
+    victim_dir = os.path.join(tmp, "victim")
+    sup = GroupSupervisor(
+        _worker_argv(write_spec(victim_dir)), 1, workdir=victim_dir,
+        member_env=lambda rank, gen: (
+            {"PERCEIVER_FAULTS": "train.kill@at=4"} if gen == 0
+            else {}),
+        name="train-pg")
+    try:
+        reforms = sup.run(timeout_s=600.0)
+    finally:
+        sup.close()
+    assert reforms == 1, reforms
+
+    g0 = _telemetry_losses(victim_dir, 0)
+    g1 = _telemetry_losses(victim_dir, 1)
+    anchors_g0 = os.path.join(victim_dir, "anchors", "g0")
+    anchor = CheckpointHook(anchors_g0,
+                            monitor="").newest_restorable_step()
+    assert anchor is not None and anchor >= 1, anchor
+    with open(os.path.join(victim_dir, "result.g1.r0.json")) as f:
+        result = json.load(f)
+    assert result["final_step"] == TARGET_STEP, result
+    # generation 1 resumed from EXACTLY the newest verified anchor of
+    # generation 0 and logged the consecutive remainder of the run
+    assert result["resumed_from"] == anchors_g0, result
+    assert sorted(g1) == list(range(anchor + 1, TARGET_STEP + 1)), \
+        (anchor, sorted(g1))
+    assert sorted(set(g0) | set(g1)) == \
+        list(range(1, TARGET_STEP + 1)), (sorted(g0), sorted(g1))
+    # the stitched trace matches the control BITWISE: every step either
+    # generation logged carries the exact float the uninterrupted run
+    # produced (anchor restore + epoch-seeded replay, no drift)
+    stitched = dict(g0)
+    stitched.update(g1)
+    mismatches = {s: (stitched[s], control[s]) for s in stitched
+                  if stitched[s] != control[s]}
+    assert not mismatches, mismatches
+    log = events_mod.default_log()
+    leaves = [e for e in log.events("host_leave")
+              if e["group"] == "train-pg"]
+    reform_events = [e for e in log.events("group_reform")
+                     if e["group"] == "train-pg"]
+    assert leaves and leaves[0]["exit_code"] != 0, leaves
+    assert reform_events and reform_events[0]["generation"] == 1, \
+        reform_events
+    return {"control_steps": len(control), "killed_after_step": anchor,
+            "g0_steps": sorted(g0), "g1_steps": sorted(g1),
+            "resumed_from_step": anchor, "reforms": reforms,
+            "bitwise_identical": True,
+            "faults_fired": {"train.kill": 1}}
+
+
+def scenario_dist_kill_serve_host(tmp: str) -> dict:
+    """kill -9 ONE host of a 2-member sharded replica group mid-
+    traffic: the group declares itself dead as a whole (survivors of a
+    torn collective can't serve), the fleet supervisor re-forms it as
+    a fresh generation, and the router fails traffic over to the
+    sibling group throughout — zero dropped requests."""
+    store = _fleet_store(tmp, versions=("v1",))
+    crash_env = {"PERCEIVER_FAULTS": "replica.crash@at=5"}
+    fleet = _start_fleet(tmp, store, replicas=2, group_size=2,
+                         per_replica_env={"r0.m0": crash_env},
+                         dispatch_timeout_s=8.0)
+    try:
+        counts, dropped = _fleet_traffic(fleet, threads=4, requests=25)
+        # wait for the replacement GROUP to rejoin the router
+        deadline = time.monotonic() + 120
+        while (fleet.supervisor.restarts_of("r0") < 1
+               or fleet.size() < 2) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        restarts = fleet.supervisor.restarts_of("r0")
+        size = fleet.size()
+        statuses = fleet.statuses()
+        from perceiver_tpu.obs import events as events_mod
+
+        log = events_mod.default_log()
+        deaths = log.events("replica_death")
+        respawns = log.events("replica_respawn")
+        leaves = [e for e in log.events("host_leave")
+                  if e["group"] == "r0"]
+        joins = [e for e in log.events("host_join")
+                 if e["group"] == "r0"]
+        reforms = [e for e in log.events("group_reform")
+                   if e["group"] == "r0"]
+    finally:
+        fleet.close()
+    assert not dropped, dropped
+    assert counts["ok"] >= 90, counts     # the fleet kept serving
+    assert restarts >= 1, "victim group never died"
+    assert size == 2, size                # the slot was re-formed
+    # the replacement is a FULL group again, not a zombie quorum
+    assert statuses.get("r0", {}).get("group_size") == 2, statuses
+    assert any(e["replica"] == "r0" for e in deaths), deaths
+    assert any(e["replica"] == "r0" for e in respawns), respawns
+    assert leaves, "no host_leave for the killed member"
+    assert len(joins) >= 4, joins         # 2 at spawn + 2 at re-form
+    assert reforms and reforms[0]["generation"] >= 1, reforms
+    return {"requests": counts, "dropped": len(dropped),
+            "group_restarts": restarts, "fleet_size_after": size,
+            "host_leave_events": len(leaves),
+            "host_join_events": len(joins),
+            "group_reform_events": len(reforms),
+            "faults_fired": {"replica.crash": restarts}}
+
+
+def scenario_dist_cutover_kill(tmp: str) -> dict:
+    """SIGKILL a group member BETWEEN stage and swap of the two-phase
+    cutover (``replica.commit_crash`` fires at commit entry): the
+    already-committed member is rolled back to the previous version,
+    the rollout aborts typed, the store's CURRENT pointer never moves,
+    the supervisor re-forms the group on the old version, and the
+    concurrent traffic never drops a request — no client ever observes
+    torn params."""
+    from perceiver_tpu.distributed.serving_group import GroupCutoverError
+    from perceiver_tpu.fleet import RolloutAborted
+
+    store = _fleet_store(tmp, versions=("v1", "v2"))
+    crash_env = {"PERCEIVER_FAULTS": "replica.commit_crash@at=0"}
+    fleet = _start_fleet(tmp, store, replicas=2, group_size=2,
+                         per_replica_env={"r0.m1": crash_env},
+                         dispatch_timeout_s=8.0)
+    try:
+        import threading as _threading
+
+        background = {"counts": None, "dropped": None}
+
+        def traffic():
+            background["counts"], background["dropped"] = \
+                _fleet_traffic(fleet, threads=2, requests=40,
+                               interval_s=0.02)
+
+        t = _threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let traffic establish before the rollout
+        aborted = None
+        try:
+            fleet.rolling_update("v2")
+        except RolloutAborted as e:
+            aborted = e
+        t.join(300)
+        # the supervisor re-forms r0; wait for the whole fleet to
+        # converge back onto the OLD version
+        deadline = time.monotonic() + 120
+        versions = {}
+        while time.monotonic() < deadline:
+            versions = {rid: s.get("version")
+                        for rid, s in fleet.statuses().items()}
+            if len(versions) == 2 and set(versions.values()) == {"v1"}:
+                break
+            time.sleep(0.2)
+        from perceiver_tpu.obs import events as events_mod
+
+        log = events_mod.default_log()
+        staged = {e["replica"] for e in log.events("cutover_stage")
+                  if e["version"] == "v2"
+                  and e["replica"].startswith("r0.")}
+        acked = {e["replica"] for e in log.events("cutover_ack")
+                 if e["version"] == "v2"
+                 and e["replica"].startswith("r0.")}
+        rollbacks = log.events("cutover_rollback")
+        reforms = [e for e in log.events("group_reform")
+                   if e["group"] == "r0"]
+    finally:
+        fleet.close()
+    counts, dropped = background["counts"], background["dropped"]
+    assert aborted is not None, "cutover kill did not abort the rollout"
+    assert isinstance(aborted.cause, GroupCutoverError), aborted.cause
+    assert store.current() == "v1"        # CURRENT never moved
+    assert set(versions.values()) == {"v1"}, versions
+    assert counts is not None and not dropped, dropped
+    # two-phase ordering: BOTH members staged before any commit...
+    assert staged == {"r0.m0", "r0.m1"}, staged
+    # ...m0 committed and acked v2; m1 died at commit entry, so its
+    # ack never appears and the group handle rolled the commit back
+    assert acked == {"r0.m0"}, acked
+    assert any(e["replica"] == "r0" and e["version"] == "v1"
+               for e in rollbacks), rollbacks
+    assert reforms, "killed group was never re-formed"
+    return {"requests": counts, "dropped": len(dropped),
+            "current_after": store.current(),
+            "replica_versions": versions,
+            "staged_members": sorted(staged),
+            "acked_members": sorted(acked),
+            "rollback_events": len(rollbacks),
+            "group_reform_events": len(reforms),
+            "rolled_back": aborted.cause.rolled_back,
+            "rollback_failed": aborted.cause.rollback_failed,
+            "faults_fired": {"replica.commit_crash": 1}}
+
+
 # scenario name -> (fault plan armed via PERCEIVER_FAULTS, fn)
 _SCENARIOS = {
     "loader_crash": ("loader.exception@at=1,count=2",
@@ -663,6 +1016,12 @@ _SCENARIOS = {
     "fleet_stall": (None, scenario_fleet_stall),
     "fleet_rollout_corrupt": (None, scenario_fleet_rollout_corrupt),
     "fleet_rollout": (None, scenario_fleet_rollout),
+    # dist scenarios likewise arm faults per-member (group supervisor /
+    # fleet per_replica_env seams), never in the scenario child itself
+    "dist_coordinator_loss": (None, scenario_dist_coordinator_loss),
+    "dist_kill_train_host": (None, scenario_dist_kill_train_host),
+    "dist_kill_serve_host": (None, scenario_dist_kill_serve_host),
+    "dist_cutover_kill": (None, scenario_dist_cutover_kill),
 }
 _MATRIX = ["loader_crash", "nan_skip", "nan_rewind", "truncated_ckpt",
            "kill_save", "preempt", "serve_dispatch"]
@@ -670,6 +1029,9 @@ _FAST = ["nan_skip", "serve_dispatch"]
 _FLEET_MATRIX = ["fleet_kill_replica", "fleet_stall",
                  "fleet_rollout_corrupt", "fleet_rollout"]
 _FLEET_FAST = ["fleet_kill_replica"]
+_DIST_MATRIX = ["dist_coordinator_loss", "dist_kill_train_host",
+                "dist_kill_serve_host", "dist_cutover_kill"]
+_DIST_FAST = ["dist_cutover_kill"]
 
 
 def _run_child(name: str, tmp: str) -> dict:
@@ -700,6 +1062,13 @@ def main() -> int:
                          "process router/rollout/failover scenarios)")
     ap.add_argument("--fleet-fast", action="store_true",
                     help=f"tier-1 fleet subset {_FLEET_FAST}")
+    ap.add_argument("--dist", action="store_true",
+                    help=f"the multi-host matrix {_DIST_MATRIX} "
+                         "(process-group training recovery, "
+                         "coordinator loss, group-replica failover, "
+                         "two-phase cutover kill)")
+    ap.add_argument("--dist-fast", action="store_true",
+                    help=f"tier-1 multi-host subset {_DIST_FAST}")
     ap.add_argument("--only", nargs="*", default=None,
                     help="run just these scenarios")
     ap.add_argument("--out", default=None,
@@ -725,6 +1094,10 @@ def main() -> int:
         names = _FLEET_MATRIX
     elif args.fleet_fast:
         names = _FLEET_FAST
+    elif args.dist:
+        names = _DIST_MATRIX
+    elif args.dist_fast:
+        names = _DIST_FAST
     else:
         names = args.only or (_FAST if args.fast else _MATRIX)
     unknown = [n for n in names

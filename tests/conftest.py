@@ -27,6 +27,88 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# --- CPU-backend multiprocess probe (shared skip gate) ----------------------
+# Not every jaxlib CPU wheel ships cross-process collectives (Gloo):
+# some builds form the cluster fine and then reject the first
+# collective with the exact signature below. One cached two-process
+# probe serves every test that needs real cross-process collectives
+# (test_multiprocess.py, test_distributed.py) — any OTHER failure
+# (hang, crash, wrong metrics) still fails loudly, so the skip cannot
+# hide a real regression.
+
+_TESTS_ROOT = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(_TESTS_ROOT)
+
+# the smallest program that exercises a cross-process collective on
+# the CPU backend: cluster init + one broadcast_one_to_all
+_PROBE_SRC = """\
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
+                           num_processes=2, process_id=int(sys.argv[1]))
+import numpy as np
+from jax.experimental import multihost_utils
+multihost_utils.broadcast_one_to_all(np.ones((2,)))
+print("PROBE-OK")
+"""
+
+NO_CPU_COLLECTIVES = ("Multiprocess computations aren't implemented "
+                      "on the CPU backend")
+
+
+def free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+import functools  # noqa: E402
+
+
+@functools.lru_cache(maxsize=1)
+def cpu_multiprocess_collectives_error():
+    """The known unsupported-backend signature if this jaxlib's CPU
+    backend cannot run cross-process collectives, else None. Cached:
+    every caller shares one ~15 s probe instead of each paying a full
+    worker startup just to hit the same error."""
+    import subprocess
+    import sys
+
+    port = free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SRC.format(port=port), str(i)],
+        env=env, cwd=REPO_ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        # a hang is NOT the known signature — run the real test and
+        # let it fail loudly
+        return None
+    if any(p.returncode != 0 for p in procs) \
+            and any(NO_CPU_COLLECTIVES in o for o in outs):
+        return NO_CPU_COLLECTIVES
+    return None
+
+
+@pytest.fixture(scope="session")
+def multiprocess_collectives_error():
+    """Fixture face of the cached probe, for tests that prefer
+    injection over importing from conftest."""
+    return cpu_multiprocess_collectives_error()
+
 
 @pytest.fixture(scope="session")
 def lowered_target_cache():
@@ -100,6 +182,8 @@ _SLOW = {
     "test_resilience.py::test_preemption_fault_roundtrip_with_verified_checkpoint",
     "test_resilience.py::test_trainer_loader_crash_survived_by_supervisor",
     "test_obs.py::test_fleet_kill_yields_one_trace_with_retry",
+    "test_distributed.py::TestBootstrap::"
+    "test_worker_bootstrap_only_forms_real_cluster",
 }
 
 

@@ -61,3 +61,33 @@ def test_chaos_fleet_fast_survives():
     assert detail["faults_fired"].get("replica.crash", 0) >= 1
     assert detail["router_retries"] >= 1  # the router actually failed over
     assert detail["fleet_size_after"] == 3  # crashed replica restarted
+
+
+def test_chaos_dist_fast_survives():
+    """The multi-host cutover gate (ISSUE 13): a group member is
+    killed between stage and commit during a rolling update; the
+    two-phase protocol rolls the group back and the store's CURRENT
+    pointer never moves. The full matrix (coordinator loss, bitwise
+    train-host recovery, sharded-replica failover) runs via ``--dist``
+    outside tier-1.
+    """
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "chaos.py"),
+         "--dist-fast"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, f"\n{proc.stdout}\n{proc.stderr}"
+
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    by_metric = {ln["metric"]: ln for ln in lines}
+    for line in lines:
+        assert {"metric", "value", "unit", "vs_baseline",
+                "detail"} <= set(line)
+    assert by_metric["chaos_matrix"]["value"] == 1.0
+    kill = by_metric["chaos_dist_cutover_kill"]
+    assert kill["value"] == 1.0
+    detail = kill["detail"]
+    assert detail["dropped"] == 0
+    assert detail["current_after"] == "v1"  # CURRENT never moved
+    assert detail["faults_fired"].get("replica.commit_crash", 0) >= 1

@@ -13,77 +13,26 @@ multi-process.
 Not every jaxlib CPU wheel ships cross-process collectives (Gloo):
 some builds form the cluster fine and then reject the first collective
 with ``INVALID_ARGUMENT: Multiprocess computations aren't implemented
-on the CPU backend``. A cached two-process probe detects exactly that
-signature and skips — any OTHER failure (hang, crash, wrong metrics)
-still fails loudly, so the skip cannot hide a real regression.
+on the CPU backend``. The cached two-process probe in
+``tests/conftest.py`` (shared with ``test_distributed.py``) detects
+exactly that signature and skips — any OTHER failure (hang, crash,
+wrong metrics) still fails loudly, so the skip cannot hide a real
+regression.
 """
 
-import functools
 import json
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
 
+from conftest import (
+    cpu_multiprocess_collectives_error,
+    free_port as _free_port,
+)
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-# the smallest program that exercises a cross-process collective on
-# the CPU backend: cluster init + one broadcast_one_to_all
-_PROBE_SRC = """\
-import os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax
-jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
-                           num_processes=2, process_id=int(sys.argv[1]))
-import numpy as np
-from jax.experimental import multihost_utils
-multihost_utils.broadcast_one_to_all(np.ones((2,)))
-print("PROBE-OK")
-"""
-
-_NO_CPU_COLLECTIVES = ("Multiprocess computations aren't implemented "
-                       "on the CPU backend")
-
-
-@functools.lru_cache(maxsize=1)
-def _cpu_multiprocess_collectives_error():
-    """The known unsupported-backend signature if this jaxlib's CPU
-    backend cannot run cross-process collectives, else None. Cached:
-    both parametrizations share one ~15 s probe instead of each paying
-    a full worker startup just to hit the same error."""
-    port = _free_port()
-    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
-    env.pop("XLA_FLAGS", None)
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", _PROBE_SRC.format(port=port), str(i)],
-        env=env, cwd=ROOT, stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT, text=True) for i in range(2)]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=180)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
-        # a hang is NOT the known signature — run the real test and
-        # let it fail loudly
-        return None
-    if any(p.returncode != 0 for p in procs) \
-            and any(_NO_CPU_COLLECTIVES in o for o in outs):
-        return _NO_CPU_COLLECTIVES
-    return None
 
 
 @pytest.mark.slow
@@ -99,7 +48,7 @@ def _cpu_multiprocess_collectives_error():
 ])
 def test_two_process_distributed_training(tmp_path, devices_per_proc,
                                           model_parallel):
-    err = _cpu_multiprocess_collectives_error()
+    err = cpu_multiprocess_collectives_error()
     if err:
         pytest.skip("this jaxlib's CPU backend cannot run "
                     f"cross-process collectives: {err}")
