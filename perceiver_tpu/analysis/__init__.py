@@ -42,6 +42,7 @@ from perceiver_tpu.analysis.shardcheck import (  # noqa: F401
 )
 from perceiver_tpu.analysis.targets import (  # noqa: F401
     CANONICAL_TARGETS,
+    DECODE_TARGETS,
     FAST_TARGETS,
     MeshSpec,
     PACKED_SERVING_TARGETS,
@@ -50,8 +51,10 @@ from perceiver_tpu.analysis.targets import (  # noqa: F401
     StepTarget,
     cost_bytes_accessed,
     lower_target,
+    make_decode_step,
     make_packed_serve_step,
     make_serve_step,
+    make_sharded_decode_step,
     make_sharded_serve_step,
     make_train_step,
 )

@@ -234,6 +234,67 @@ def make_packed_serve_step(task, batch):
     return jitted, args, expected
 
 
+def make_decode_step(task, batch):
+    """The autoregressive decode step jit — the exact executable
+    ``DecodeEngine`` AOT-compiles once per pool geometry and then runs
+    for every token of every stream (serving/decode.py). ``batch``
+    carries the ``DecodeGeometry`` plus one round of per-slot
+    ``tokens``/``active`` inputs. Returns
+    ``(jitted_fn, args, expected_donated)``: the whole carry (KV pools,
+    lengths, page tables) is donated — every leaf aliases an output, so
+    the step's HBM high-water mark is ONE copy of the paged cache."""
+    import jax
+
+    from perceiver_tpu.serving.decode import build_decode_graph
+
+    graph = build_decode_graph(task.build(), batch["geometry"],
+                               attn_impl=batch.get("attn_impl", "pallas"))
+    params = graph.init_params()
+    carry = graph.init_carry()
+    args = (params, carry, batch["tokens"], batch["active"])
+    jitted = jax.jit(graph.fn, donate_argnums=graph.donate_argnums)
+    expected = len(jax.tree_util.tree_leaves(carry))
+    return jitted, args, expected
+
+
+def make_sharded_decode_step(task, batch, mesh):
+    """The sharded decode step: params tensor-parallel (``model``),
+    per-stream rows (tokens/active/lengths/page tables) batch-sharded
+    over ``data``, and the KV pools replicated — each pool is a shared
+    arena indexed by data-local page tables, and at canonical geometry
+    it sits far below the replication floor (the replication pass still
+    audits it). Lowers the ``"reference"`` attention path: GSPMD
+    partitions gathers/einsums, not Pallas calls. Donation survives
+    sharding — carry leaves and the outputs they alias carry identical
+    specs. Returns ``(jitted_fn, args, expected_donated)``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from perceiver_tpu.parallel.sharding import param_sharding
+    from perceiver_tpu.serving.decode import build_decode_graph
+
+    graph = build_decode_graph(task.build(), batch["geometry"],
+                               attn_impl=batch.get("attn_impl",
+                                                   "reference"))
+    params = graph.init_params()
+    carry = graph.init_carry()
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P("data"))
+    carry_sh = {
+        "kv": {name: rep for name in carry["kv"]},
+        "lengths": row,
+        "page_tables": NamedSharding(mesh, P("data", None)),
+    }
+    args = (params, carry, batch["tokens"], batch["active"])
+    jitted = jax.jit(
+        graph.fn, donate_argnums=graph.donate_argnums,
+        in_shardings=(param_sharding(params, mesh), carry_sh, row, row),
+        out_shardings=(carry_sh,
+                       {name: row for name in graph.output_names}))
+    expected = len(jax.tree_util.tree_leaves(carry))
+    return jitted, args, expected
+
+
 def make_sharded_serve_step(task, batch, mesh):
     """The sharded serve-graph jit: the same graph + donation layout
     as ``make_serve_step``, under explicit GSPMD shardings (params
@@ -306,10 +367,14 @@ def lower_target(target: StepTarget, cache=None,
         expected = len(jax.tree_util.tree_leaves((params, opt_state)))
     elif mesh is not None and target.kind == "serve":
         step, args, expected = make_sharded_serve_step(task, batch, mesh)
+    elif mesh is not None and target.kind == "decode":
+        step, args, expected = make_sharded_decode_step(task, batch, mesh)
     elif target.kind == "serve":
         step, args, expected = make_serve_step(task, batch)
     elif target.kind == "packed_serve":
         step, args, expected = make_packed_serve_step(task, batch)
+    elif target.kind == "decode":
+        step, args, expected = make_decode_step(task, batch)
     else:
         step, args = make_train_step(task, batch)
         params, opt_state = args[0], args[1]
@@ -571,6 +636,54 @@ PACKED_SERVING_TARGETS = (
 
 
 # --------------------------------------------------------------------------
+# Decode targets: ONE stepped executable per pool geometry — the step
+# DecodeEngine runs for every token of every stream. The canonical
+# geometry is 8 slots over a 64-page × 16-token shared KV pool at the
+# BASELINE MLM recipe shapes. The hbm_budget pin on this target IS the
+# O(1) memory gate for the paged-decode claim: the step's bytes
+# accessed are geometry-bound (pools + params), independent of how
+# many tokens any stream has generated — a regression that makes cost
+# grow with sequence position would move the pin.
+
+def _decode_batch_mlm(vocab: int = 10003, seq: int = 512,
+                      channels: int = 64, streams: int = 8,
+                      num_pages: int = 64, page_size: int = 16,
+                      attn_impl: str = "pallas"):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_tpu.serving.decode import DecodeGeometry
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    task = MaskedLanguageModelTask(
+        vocab_size=vocab, max_seq_len=seq, num_latent_channels=channels)
+    rng = np.random.default_rng(0)
+    return task, {
+        "geometry": DecodeGeometry(
+            max_streams=streams, num_pages=num_pages,
+            page_size=page_size, max_seq_len=seq),
+        "tokens": jnp.asarray(
+            rng.integers(3, vocab, (streams,)), jnp.int32),
+        "active": jnp.ones((streams,), jnp.bool_),
+        "attn_impl": attn_impl,
+    }
+
+
+def _decode_batch_mlm_spmd():
+    # reference attention: GSPMD partitions gathers, not Pallas calls;
+    # vocab/seq follow the SPMD serve rung (_SPMD_MLM) so the model
+    # axis divides the vocab projection evenly
+    return _decode_batch_mlm(vocab=8192, seq=256, num_pages=48,
+                             attn_impl="reference")
+
+
+DECODE_TARGETS = (
+    StepTarget(name="decode_mlm_r8_p64x16", build=_decode_batch_mlm,
+               kind="decode"),
+)
+
+
+# --------------------------------------------------------------------------
 # Sharded (SPMD) targets: the first mesh rung — dp2×tp2 over 4 CPU
 # devices (virtual via --xla_force_host_platform_device_count; the
 # same specs place on a v4-8 slice unchanged). Shapes shrink from the
@@ -610,6 +723,23 @@ SHARDED_TARGETS = (
     StepTarget(name="serve_mlm_spmd_b32_s256_dp2_tp2",
                build=_serve_batch_mlm_spmd, kind="serve", mesh=DP2_TP2,
                replication_allow=_SPMD_MLM_EMBED_ALLOW),
+    StepTarget(name="decode_mlm_spmd_r8_p48x16_dp2_tp2",
+               build=_decode_batch_mlm_spmd, kind="decode",
+               mesh=DP2_TP2,
+               replication_allow=_SPMD_MLM_EMBED_ALLOW,
+               # the reference paged-attention path upcasts q/k/v to
+               # fp32 (ops/paged_attention.py) to match the Pallas
+               # kernel's fp32 online-softmax accumulator bit-for-bit
+               # in tests — two QK^T and two PV dots per step (layer_1
+               # + the scanned layer_n), ~9% of step dot-FLOPs each
+               dtype_allow=(
+                   DtypeAllow(
+                       dtype="f32", max_count=4,
+                       reason="reference paged-attention fp32 "
+                              "accumulation — parity twin of the "
+                              "Pallas kernel's fp32 online-softmax "
+                              "accumulator; production decode lowers "
+                              "the bf16 Pallas kernel instead"),)),
 )
 
 
@@ -624,7 +754,8 @@ CANONICAL_TARGETS = (
     StepTarget(name="text_clf_b64", build=_build_text_clf),
     StepTarget(name="img_clf_b512", build=_build_img_clf),
     StepTarget(name="seg_512x512_b1", build=_build_seg),
-) + SERVING_TARGETS + PACKED_SERVING_TARGETS + SHARDED_TARGETS
+) + (SERVING_TARGETS + PACKED_SERVING_TARGETS + DECODE_TARGETS
+     + SHARDED_TARGETS)
 
 # --fast also drops the mesh targets: they are the only targets that
 # must be XLA-COMPILED (collectives appear post-partitioning), and the

@@ -57,10 +57,13 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 from perceiver_tpu.fleet.rpc import RpcServer
 from perceiver_tpu.obs import trace as trace_mod
 from perceiver_tpu.resilience import faults
 from perceiver_tpu.serving.api import materialize, materialize_packed
+from perceiver_tpu.serving.batcher import Overloaded
 from perceiver_tpu.serving.errors import Unavailable
 
 
@@ -119,6 +122,24 @@ class ReplicaServer:
             breaker_failure_threshold=spec.get(
                 "breaker_failure_threshold", 5),
             breaker_reset_s=spec.get("breaker_reset_s", 30.0))
+        # opt-in decode engine (spec key "decode" = geometry kwargs):
+        # same task/params tree, same metrics registry — one exposition
+        # covers both planes, and the compile listener above counts its
+        # step compile in the zero-compile spin-up budget
+        self.decode_engine = None
+        if spec.get("decode"):
+            from perceiver_tpu.serving.decode import (
+                DecodeEngine,
+                DecodeGeometry,
+            )
+
+            dspec = dict(spec["decode"])
+            self._decode_max_new = int(dspec.pop("max_new_tokens_default",
+                                                 16))
+            self.decode_engine = DecodeEngine(
+                task, self.engine._params_src,
+                geometry=DecodeGeometry(**dspec),
+                metrics=self.engine.metrics)
         self.server = RpcServer(self.handle,
                                 port=int(spec.get("port", 0)),
                                 io_timeout=spec.get("io_timeout_s", 60.0))
@@ -188,7 +209,9 @@ class ReplicaServer:
                 # admission (lock/stall wait) is this replica's queue
                 ctx.record("queue_wait", start=admit_start)
             with trace_mod.attach([ctx]):
-                if "packed_ids" in arrays:
+                if "prompt_ids" in arrays:
+                    outputs = self._decode_dispatch(arrays, ctx)
+                elif "packed_ids" in arrays:
                     result = self.engine.dispatch_packed(arrays)
                     with trace_mod.region("device"):
                         outputs = materialize_packed(
@@ -207,6 +230,29 @@ class ReplicaServer:
         if ctx is not None:
             reply["spans"] = collector.spans
         return reply
+
+    def _decode_dispatch(self, arrays: dict, ctx) -> dict:
+        """Run one decode payload (``prompt_ids`` + optional
+        ``max_new_tokens``) to completion and return the full token
+        array. Token-by-token streaming stays in-process behind
+        ``serving/api.GenerationServer`` — the fleet RPC is
+        request/response, so a decode replica trades streaming for the
+        router's retry/failover semantics. A shed stream surfaces as
+        the typed ``Unavailable`` the router transparently retries on
+        a sibling."""
+        if self.decode_engine is None:
+            raise ValueError(
+                "replica has no decode engine (enable with the "
+                "'decode' spec key)")
+        max_new = int(arrays.get("max_new_tokens", self._decode_max_new))
+        handle = self.decode_engine.submit(
+            arrays["prompt_ids"], max_new_tokens=max_new, trace=ctx)
+        result = handle.result()
+        if isinstance(result, Overloaded):
+            raise Unavailable(f"decode_{result.reason}",
+                              retry_after_s=0.05)
+        return {"tokens": np.asarray(result.tokens, np.int32),
+                "ttft_s": np.asarray(result.ttft_s or 0.0, np.float64)}
 
     def _status(self) -> dict:
         metrics = self.engine.metrics
@@ -247,6 +293,8 @@ class ReplicaServer:
             params = self.store.load(version,
                                      self.engine._params_src)
             self.engine.update_params(params)
+            if self.decode_engine is not None:
+                self.decode_engine.update_params(params)
             self.version = version
         finally:
             with self._lock:
@@ -292,6 +340,8 @@ class ReplicaServer:
                 version, params = self._staged
                 self._staged = None
             self.engine.update_params(params)
+            if self.decode_engine is not None:
+                self.decode_engine.update_params(params)
             self.version = version
         finally:
             with self._lock:
@@ -314,6 +364,8 @@ class ReplicaServer:
 
     def close(self) -> None:
         self._stop.set()
+        if self.decode_engine is not None:
+            self.decode_engine.close()
         self.server.close()
 
 

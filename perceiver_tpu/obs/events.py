@@ -69,6 +69,10 @@ SCHEMA: Dict[str, Tuple[str, ...]] = {
     "cutover_stage": ("replica", "version"),
     "cutover_ack": ("replica", "version"),
     "cutover_rollback": ("replica", "version"),
+    # autoregressive decode streams (serving/decode.py): one open /
+    # close pair per stream; "tokens" = generated count at close
+    "stream_open": ("stream",),
+    "stream_close": ("stream", "tokens"),
 }
 
 

@@ -65,6 +65,8 @@ PHASES = (
     "route",        # router: replica selection
     "rpc_hop",      # router: one RPC attempt against one replica
     "retry",        # router: backoff + re-pick after a failed hop
+    "decode_step",  # decode engine: one stepped-executable iteration
+    "token_emit",   # decode engine: one generated token handed out
 )
 
 _enabled = True

@@ -1,0 +1,204 @@
+"""Paged decode attention: per-request page tables over a shared KV
+pool (PAPERS: "Ragged Paged Attention").
+
+Autoregressive decode keeps one KV cache entry per *consumed* token.
+A rectangle per stream — ``(R, max_seq, H, Dh)`` — wastes HBM on
+every stream shorter than the longest and fragments nothing-shaped
+holes when streams leave mid-flight. The paged layout instead shares
+one fixed pool of ``num_pages`` blocks of ``page_size`` tokens::
+
+    k_pages, v_pages : (num_pages, page_size, H, Dh)   the shared pool
+    page_tables      : (R, pages_per_stream) int32     logical→physical
+    lengths          : (R,) int32                      tokens cached
+
+Stream ``r``'s token ``t`` lives at physical page
+``page_tables[r, t // page_size]``, slot ``t % page_size`` — so a
+host-side allocator can hand any free page to any stream and recycle
+freed pages without moving a byte (``serving/decode.PagePool``).
+
+:func:`paged_decode_attention` is the Pallas kernel: grid
+``(R, H, pages_per_stream)``, the page table and lengths ride scalar
+prefetch so the kv index map walks **only request r's own page
+list**; steps past ``ceil(length / page_size)`` replay the clamped
+last page, which the pipeline elides, and compute under them is
+predicated off. Online softmax shares its body with the flash and
+ragged kernels (``ops/online_softmax.py``). Accumulation order is
+the logical page order, independent of physical placement — so two
+placements of the same stream (contiguous vs scrambled) produce
+**bitwise identical** outputs, the property the decode parity tests
+pin.
+
+Layout note: the kernel wants the token axis on the sublane dim, so
+the wrapper relayouts pages to ``(P, H, page_size, Dp)`` (one
+transpose + lane pad per call). The pools here are small — tens of
+KiB for the canonical configs — so this stays cheap and O(1) per
+step; a production TPU build would allocate the pool in kernel
+layout directly and skip the copy.
+
+:func:`paged_decode_attention_reference` is the pure-jax gather
+reference; it uses ``lax.select`` (never ``jnp.where``) because the
+sharded decode serve graph lowers it, and jnp.where's jitted wrapper
+makes module text drift with process history (see
+serving/graphs.py).
+
+Both run in Pallas interpreter mode on non-TPU backends, so CPU
+tests exercise the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from perceiver_tpu.ops.chunked_attention import NEG_INF
+from perceiver_tpu.ops.online_softmax import (
+    online_softmax_finish,
+    online_softmax_init,
+    online_softmax_update,
+)
+from perceiver_tpu.ops.ragged_attention import _resolve_interpret
+from perceiver_tpu.ops.tiling import round_up as _round_up
+
+
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_ref, l_ref, acc_ref, *, scale: float,
+                         page_size: int, n_steps: int):
+    r = pl.program_id(0)
+    j = pl.program_id(2)
+    length = lens_ref[r]
+
+    @pl.when(j == 0)
+    def _():
+        online_softmax_init(m_ref, l_ref, acc_ref)
+
+    # steps past the stream's used pages replay the clamped last page
+    # (see kv index map) — skip them; zero-length streams do no work
+    # and finish with exact-zero outputs
+    @pl.when(j * page_size < length)
+    def _():
+        q = q_ref[0, 0]        # (Nqp, Dp)
+        kblk = k_ref[0, 0]     # (page_size, Dp)
+        vblk = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        # mask the tail slots of the stream's last partial page
+        col = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = s + jnp.where(col < length, 0.0, NEG_INF)
+        online_softmax_update(s, vblk, m_ref, l_ref, acc_ref)
+
+    @pl.when(j == n_steps - 1)
+    def _():
+        o_ref[0, 0] = online_softmax_finish(
+            m_ref, l_ref, acc_ref).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths, *,
+                           scale: Optional[float] = None,
+                           interpret: Optional[bool] = None):
+    """Decode attention over a paged KV pool.
+
+    q: (R, H, Nq, D) per-stream queries (the decode step's latent
+    queries, Nq = num latents); k_pages/v_pages:
+    (num_pages, page_size, H, D) shared pool; page_tables:
+    (R, pages_per_stream) int32; lengths: (R,) int32 — stream r
+    attends its first ``lengths[r]`` cached tokens, walked through
+    its own page list. Table entries beyond the used pages may be
+    arbitrary (they are clamped and never contribute). Streams with
+    ``lengths[r] == 0`` return zeros. Returns (R, H, Nq, D) in q's
+    dtype.
+    """
+    interpret = _resolve_interpret(interpret)
+    r, h, nq, d = q.shape
+    num_pages, page_size = k_pages.shape[:2]
+    pps = page_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    dp = _round_up(d, 128)
+    nqp = _round_up(nq, 16)
+
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, nqp - nq), (0, dp - d)))
+    # pool → kernel layout (P, H, page_size, Dp): token axis on the
+    # sublane dim, head axis blockable at size 1 (see module docstring)
+    kp = jnp.pad(jnp.transpose(k_pages, (0, 2, 1, 3)),
+                 ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+    vp = jnp.pad(jnp.transpose(v_pages, (0, 2, 1, 3)),
+                 ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+
+    def kv_index(rr, hh, j, tables, lens):
+        # clamp to the last used page: replayed blocks are elided by
+        # the pipeline, and compute under them is predicated off
+        used = jnp.maximum(
+            (lens[rr] + page_size - 1) // page_size, 1)
+        jj = jnp.minimum(j, used - 1)
+        page = jnp.clip(tables[rr, jj], 0, num_pages - 1)
+        return (page, hh, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(r, h, pps),
+        in_specs=[
+            pl.BlockSpec((1, 1, nqp, dp),
+                         lambda rr, hh, j, tables, lens: (rr, hh, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, dp), kv_index),
+            pl.BlockSpec((1, 1, page_size, dp), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, nqp, dp),
+            lambda rr, hh, j, tables, lens: (rr, hh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nqp, 128), jnp.float32),
+            pltpu.VMEM((nqp, 128), jnp.float32),
+            pltpu.VMEM((nqp, dp), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=float(scale),
+                          page_size=page_size, n_steps=pps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, h, nqp, dp), q.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qp, kp, vp)
+    return out[:, :, :nq, :d]
+
+
+def paged_decode_attention_reference(q, k_pages, v_pages, page_tables,
+                                     lengths, *,
+                                     scale: Optional[float] = None):
+    """Pure-jax reference for :func:`paged_decode_attention`.
+
+    Gathers each stream's pages into a dense (R, pps·page_size, H, D)
+    view and runs masked fp32 attention. This is also the impl the
+    sharded (dp2×tp2) decode target lowers — GSPMD partitions gathers
+    and einsums, not Pallas calls — hence ``lax.select`` throughout.
+    """
+    r, h, nq, d = q.shape
+    num_pages, page_size = k_pages.shape[:2]
+    pps = page_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    tables = jnp.clip(page_tables.astype(jnp.int32), 0, num_pages - 1)
+    k = jnp.take(k_pages, tables.reshape(-1), axis=0).reshape(
+        r, pps * page_size, k_pages.shape[2], d)
+    v = jnp.take(v_pages, tables.reshape(-1), axis=0).reshape(
+        r, pps * page_size, v_pages.shape[2], d)
+    col = jnp.arange(pps * page_size, dtype=jnp.int32)
+    mask = col[None, :] < lengths[:, None]            # (R, T)
+    logits = jnp.einsum("rhnd,rthd->rhnt", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    logits = jax.lax.select(
+        jnp.broadcast_to(mask[:, None, None, :], logits.shape),
+        logits, jnp.full_like(logits, NEG_INF))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("rhnt,rthd->rhnd", probs, v.astype(jnp.float32))
+    out = jax.lax.select(
+        jnp.broadcast_to((lengths > 0)[:, None, None, None], out.shape),
+        out, jnp.zeros_like(out))
+    return out.astype(q.dtype)

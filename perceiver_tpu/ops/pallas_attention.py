@@ -56,6 +56,11 @@ from jax.experimental.pallas import tpu as pltpu
 from perceiver_tpu.ops.tiling import round_up as _round_up
 
 from perceiver_tpu.ops.chunked_attention import NEG_INF, chunked_attention
+from perceiver_tpu.ops.online_softmax import (
+    online_softmax_finish,
+    online_softmax_init,
+    online_softmax_update,
+)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
@@ -65,9 +70,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
 
     @pl.when(ik == 0)
     def _():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        online_softmax_init(m_ref, l_ref, acc_ref)
 
     q = q_ref[0, 0]  # (block_q, Dp)
     k = k_ref[0, 0]  # (block_k, Dp)
@@ -80,22 +83,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
     # be 8-divisible or full); select this program's row dynamically
     s = s + bias_ref[pl.ds(ib, 1), :]
 
-    m_prev = m_ref[:, :1]                                # (block_q, 1)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    online_softmax_update(s, v, m_ref, l_ref, acc_ref)
 
     @pl.when(ik == nk - 1)
     def _():
-        o_ref[0, 0] = (acc_ref[:] /
-                       jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        o_ref[0, 0] = online_softmax_finish(
+            m_ref, l_ref, acc_ref).astype(o_ref.dtype)
 
 
 def _flash_forward(q, k, v, bias, scale: float,

@@ -43,6 +43,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from perceiver_tpu.ops.chunked_attention import NEG_INF
+from perceiver_tpu.ops.online_softmax import (
+    online_softmax_finish,
+    online_softmax_init,
+    online_softmax_update,
+)
 from perceiver_tpu.ops.tiling import round_up as _round_up
 
 
@@ -76,9 +81,7 @@ def _ragged_cross_kernel(offs_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j == 0)
     def _():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        online_softmax_init(m_ref, l_ref, acc_ref)
 
     # steps past the request's own block span are replays of the
     # clamped last block — skip them; zero-length rows do no work at
@@ -96,21 +99,12 @@ def _ragged_cross_kernel(offs_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         col = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
         s = s + jnp.where((col >= start) & (col < end), 0.0, NEG_INF)
-        m_prev = m_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        online_softmax_update(s, vblk, m_ref, l_ref, acc_ref)
 
     @pl.when(j == nk - 1)
     def _():
-        o_ref[0, 0] = (acc_ref[:] /
-                       jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        o_ref[0, 0] = online_softmax_finish(
+            m_ref, l_ref, acc_ref).astype(o_ref.dtype)
 
 
 def ragged_cross_attention(q, k, v, row_offsets, lengths, *,

@@ -7,7 +7,9 @@ Layers, bottom to top:
 - ``engine``  — checkpoint loading, per-(batch, seq) bucket AOT
   compilation, device-resident params, sync-free dispatch;
 - ``batcher`` — thread-safe micro-batching queue with deadlines and
-  typed ``Overloaded`` load shedding;
+  typed ``Overloaded`` load shedding, plus the decode admission queue;
+- ``decode``  — autoregressive streaming generation: O(1) paged KV
+  caching through one AOT-compiled stepped executable;
 - ``errors``  — the typed failure vocabulary (``Unavailable``,
   ``BatchError``) every layer speaks (docs/RESILIENCE.md);
 - ``health``  — the health/readiness state machine the engine exports
@@ -20,9 +22,18 @@ Layers, bottom to top:
 """
 
 from perceiver_tpu.serving.batcher import (  # noqa: F401
+    AdmissionQueue,
     MicroBatcher,
     Overloaded,
     TokenBudgetBatcher,
+)
+from perceiver_tpu.serving.decode import (  # noqa: F401
+    DecodeEngine,
+    DecodeGeometry,
+    DecodeResult,
+    PagePool,
+    StreamHandle,
+    build_decode_graph,
 )
 from perceiver_tpu.serving.errors import (  # noqa: F401
     BatchError,
@@ -48,6 +59,8 @@ from perceiver_tpu.serving.graphs import (  # noqa: F401
 )
 from perceiver_tpu.serving.metrics import MetricsRegistry  # noqa: F401
 from perceiver_tpu.serving.api import (  # noqa: F401
+    Generation,
+    GenerationServer,
     ImageClassifierServer,
     MLMServer,
     SegmentationServer,

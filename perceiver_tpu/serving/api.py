@@ -378,6 +378,91 @@ class SegmentationServer(_Server):
                 for i in range(len(images))]
 
 
+# --- autoregressive generation ----------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Generation:
+    """One finished generation: the decoded continuation + timing."""
+
+    text: str                 # prompt + generated, decoded
+    prompt_text: str
+    token_ids: List[int]      # generated ids only
+    ttft_s: Optional[float]
+
+
+class GenerationServer:
+    """Streaming text generation over a :class:`DecodeEngine`
+    (docs/SERVING.md "Autoregressive decode").
+
+    Unlike the batch servers above there is no micro-batcher in front:
+    the decode engine IS the continuous batcher — every submit joins
+    the stepped executable's next admission wave, and tokens stream
+    back per step. This layer only tokenizes, decodes, and exposes the
+    three delivery shapes: blocking (:meth:`generate`), incremental
+    (:meth:`stream`), and push (:meth:`submit` with ``on_token``).
+    """
+
+    def __init__(self, engine, tokenizer):
+        from perceiver_tpu.serving.decode import DecodeEngine
+
+        if not isinstance(engine, DecodeEngine):
+            raise TypeError(
+                f"GenerationServer needs a DecodeEngine, got "
+                f"{type(engine).__name__}")
+        self.engine = engine
+        self.tokenizer = tokenizer
+
+    def submit(self, text: str, *, max_new_tokens: int,
+               timeout_ms: Optional[float] = None,
+               on_token=None):
+        """Tokenize and enqueue one stream; returns the engine's
+        ``StreamHandle``. ``on_token`` receives raw token *ids* as
+        they are generated (decode per id via ``token_text``)."""
+        ids, lengths = self.tokenizer.encode_batch_padded(
+            [text], self.engine.geometry.max_seq_len,
+            pad_id=PAD_TOKEN_ID)
+        n = max(1, int(lengths[0]))
+        row = ids[0, :n].astype(np.int32, copy=False)
+        return self.engine.submit(row, max_new_tokens=max_new_tokens,
+                                  timeout_ms=timeout_ms,
+                                  on_token=on_token)
+
+    def generate(self, text: str, *, max_new_tokens: int,
+                 timeout_ms: Optional[float] = None,
+                 timeout: Optional[float] = None):
+        """Blocking entry: returns a :class:`Generation`, or the typed
+        ``Overloaded`` value when the stream was shed."""
+        handle = self.submit(text, max_new_tokens=max_new_tokens,
+                             timeout_ms=timeout_ms)
+        result = handle.result(timeout)
+        if isinstance(result, Overloaded):
+            return result
+        return Generation(
+            text=text + self.tokenizer.decode(result.tokens),
+            prompt_text=text,
+            token_ids=list(result.tokens),
+            ttft_s=result.ttft_s)
+
+    def stream(self, text: str, *, max_new_tokens: int,
+               timeout_ms: Optional[float] = None):
+        """Incremental entry: yields each generated token's text as it
+        is emitted (blocking iterator; ends when the stream closes)."""
+        handle = self.submit(text, max_new_tokens=max_new_tokens,
+                             timeout_ms=timeout_ms)
+        for tok in handle.tokens():
+            yield self.token_text(tok)
+
+    def token_text(self, token_id: int) -> str:
+        return self.tokenizer.id_to_token(int(token_id))
+
+    def metrics_text(self) -> str:
+        return self.engine.metrics_text()
+
+    def close(self, timeout: float = 5.0) -> None:
+        self.engine.close(timeout)
+
+
 # --- predict_masked_samples compat path --------------------------------------
 
 # engines cached per (model config, k, policy): the model dataclasses

@@ -299,6 +299,110 @@ class MicroBatcher:
             p.future.set_result(r)
 
 
+@dataclasses.dataclass
+class _Queued:
+    item: object
+    cost: int
+    enqueued_at: float
+    deadline: Optional[float]
+
+
+class AdmissionQueue:
+    """FIFO admission control for *streams* (decode continuous
+    batching): bounded depth, per-entry deadlines, budget-gated pops.
+
+    This is ``TokenBudgetBatcher``'s budget logic recast for long-lived
+    entries: the decode engine ``offer``s each stream with its page
+    cost and, once per step, ``take``s the longest admissible prefix —
+    entries pop while slots remain and each head's cost fits the
+    remaining page budget. The head blocking preserves submission
+    order (no small-stream starvation of a large head: its pages free
+    up as running streams finish). Expired heads shed; the caller
+    resolves them with the typed ``Overloaded("deadline")`` just like
+    the micro-batcher would.
+
+    Unlike ``MicroBatcher`` this owns no worker thread and no futures
+    — the decode engine's step loop is the consumer — so it is safe to
+    call under the engine lock.
+    """
+
+    def __init__(self, *, max_depth: int = 64,
+                 metrics: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_depth < 1:
+            raise ValueError("max_depth must be >= 1")
+        self.max_depth = max_depth
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._queue: collections.deque = collections.deque()
+        m = metrics if metrics is not None else MetricsRegistry()
+        self._m_depth = m.gauge(
+            "serving_decode_queue_depth",
+            "streams waiting for slot + page admission")
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def offer(self, item, *, cost: int,
+              deadline: Optional[float] = None) -> bool:
+        """Enqueue one entry; False = queue full (caller sheds)."""
+        with self._lock:
+            if len(self._queue) >= self.max_depth:
+                return False
+            self._queue.append(_Queued(item, int(cost), self._clock(),
+                                       deadline))
+            self._m_depth.set(len(self._queue))
+        return True
+
+    def take(self, *, budget: int, slots: int,
+             now: Optional[float] = None):
+        """Pop the admissible FIFO prefix: entries admit while ``slots``
+        remain and their cost fits the remaining ``budget``; expired
+        heads shed along the way. Returns ``(admitted, shed)`` items.
+        """
+        if now is None:
+            now = self._clock()
+        admitted, shed = [], []
+        with self._lock:
+            while self._queue:
+                head = self._queue[0]
+                # expired heads shed even when no slot/budget is free —
+                # a caller polling take() under saturation must not sit
+                # on dead requests until capacity happens to return
+                if head.deadline is not None and now > head.deadline:
+                    self._queue.popleft()
+                    shed.append(head.item)
+                    continue
+                if slots <= 0 or head.cost > budget:
+                    break
+                self._queue.popleft()
+                admitted.append(head.item)
+                budget -= head.cost
+                slots -= 1
+            self._m_depth.set(len(self._queue))
+        return admitted, shed
+
+    def remove(self, item) -> bool:
+        """Drop one queued entry (stream cancellation)."""
+        with self._lock:
+            for e in self._queue:
+                if e.item is item:
+                    self._queue.remove(e)
+                    self._m_depth.set(len(self._queue))
+                    return True
+        return False
+
+    def drain_all(self):
+        """Empty the queue, returning the items (engine shutdown)."""
+        with self._lock:
+            items = [e.item for e in self._queue]
+            self._queue.clear()
+            self._m_depth.set(0)
+        return items
+
+
 class TokenBudgetBatcher(MicroBatcher):
     """Continuous batching by token budget instead of request count.
 

@@ -1,0 +1,863 @@
+"""Autoregressive decode: one AOT-compiled step over a paged KV pool.
+
+Every other serving path in the repo is single-shot encode→decode;
+this module adds the streaming scenario (ROADMAP item 2) with the
+perf shape as the contract: **per-token cost is O(1) in generated
+length**, because each step re-reads a fixed-shape donated carry
+instead of re-encoding the growing prefix.
+
+The carry — donated to the step executable and re-donated every
+step — is::
+
+    {"kv": {"k1","v1"[,"kn","vn"]}   (num_pages, page_size, H, Dh)
+     "lengths":     (R,) int32        tokens cached per stream slot
+     "page_tables": (R, PPS) int32    logical→physical page map}
+
+``k1/v1`` cache the *encoder cross-attention K/V projections* of
+each consumed token for the unshared first layer; ``kn/vn`` for the
+weight-shared ``layer_n`` (only when ``num_layers > 1``). That is
+the whole loop-carried state of a Perceiver-IO decode: latents are
+cheap (N×C per stream) and recomputed from the pools each step,
+which keeps the cache *per-token* and therefore pageable — the same
+block machinery as the ragged serve path (PAPERS: "Ragged Paged
+Attention"; the stepped-executable framing follows "Compiler-First
+State Space Duality and Portable O(1) Autoregressive Caching").
+
+One step consumes one token per active stream slot and emits the
+model's prediction for the *next* position:
+
+1. embed ``tokens[r]`` at position ``lengths[r]``;
+2. project its K/V per kv set and scatter into the pools at
+   ``(page_tables[r, pos // page_size], pos % page_size)`` —
+   inactive slots are redirected to the reserved trash page 0;
+3. rebuild latents: ``layer_1`` + scanned ``layer_n``, each
+   cross-attending the pools through
+   :func:`~perceiver_tpu.ops.paged_attention.paged_decode_attention`;
+4. decode one query row at position ``lengths[r] + 1`` → vocab
+   logits → greedy ``next_token`` (+ top-k sidecar).
+
+Prefill reuses the same executable: a stream's prompt feeds through
+one token per step, so the engine owns exactly ONE compiled
+signature and token N costs the same as token 1 — the decode bench
+(``scripts/bench_decode.py``) pins that ratio and zero post-warmup
+compiles as a merge gate.
+
+``DecodeEngine`` drives the step host-side: a page allocator
+(:class:`PagePool`), continuous batching (streams join and leave
+mid-flight via ``batcher.AdmissionQueue`` — freed pages recycle with
+no fragmentation because any page serves any stream), per-stream
+token callbacks / blocking iterators, tracing (``decode_step`` /
+``token_emit`` spans), typed events (``stream_open`` /
+``stream_close``), and metrics. Shedding follows the batcher
+conventions: an over-capacity or expired request resolves to a typed
+:class:`~perceiver_tpu.serving.batcher.Overloaded` value; a request
+that can *never* fit the geometry raises
+:class:`~perceiver_tpu.serving.engine.RequestTooLarge` at submit.
+
+Unlike ``serving/engine.py`` (sync-free by lint), this module is a
+consumer layer: it owns the one deliberate device sync per step
+(materializing ``next_token``), exactly like ``serving/api.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as queue_mod
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from perceiver_tpu.cache import aot_compile
+from perceiver_tpu.obs import events as events_mod
+from perceiver_tpu.obs import trace as trace_mod
+from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
+from perceiver_tpu.serving.batcher import AdmissionQueue, Overloaded
+from perceiver_tpu.serving.engine import (
+    RequestTooLarge,
+    resolve_exec_cache,
+)
+from perceiver_tpu.serving.errors import BatchError, Unavailable
+from perceiver_tpu.serving.metrics import MetricsRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeGeometry:
+    """The fixed shape of one decode executable: stream slots × paged
+    pool. Everything the step compiles against derives from here, so
+    the exec-cache key forks on any change (tests/test_exec_cache.py
+    pins the pages × page_size fork)."""
+
+    max_streams: int
+    num_pages: int          # includes the reserved trash page 0
+    page_size: int
+    max_seq_len: int        # cap on prompt + generated (position table)
+    top_k: int = 3
+
+    def __post_init__(self):
+        if self.max_streams < 1:
+            raise ValueError(f"max_streams must be >= 1, got "
+                             f"{self.max_streams}")
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got "
+                             f"{self.page_size}")
+        if self.num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the reserved trash "
+                f"page), got {self.num_pages}")
+        if self.max_seq_len < 1:
+            raise ValueError(f"max_seq_len must be >= 1, got "
+                             f"{self.max_seq_len}")
+
+    @property
+    def pages_per_stream(self) -> int:
+        """Page-table width: enough pages to reach ``max_seq_len``."""
+        return -(-self.max_seq_len // self.page_size)
+
+    @property
+    def allocatable_pages(self) -> int:
+        return self.num_pages - 1
+
+    def pages_for(self, cached_tokens: int) -> int:
+        """Pages a stream holding ``cached_tokens`` KV entries needs."""
+        return max(1, -(-cached_tokens // self.page_size))
+
+    @property
+    def descriptor(self) -> str:
+        return (f"r{self.max_streams}_p{self.num_pages}x{self.page_size}"
+                f"_s{self.max_seq_len}")
+
+
+class PagePool:
+    """Host-side free-list allocator over the pool's page indices.
+
+    Page 0 is reserved (the trash page inactive slots scatter into)
+    and never handed out. Any free page serves any stream, so recycle
+    never fragments: ``free`` simply pushes pages back on the list.
+    The allocated set is tracked to make double-free / aliasing bugs
+    loud instead of silently corrupting a neighbour stream's cache.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 reserved)")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO: pop() returns low indices first, so fresh allocations
+        # reuse just-freed pages (cache-friendly, and makes the
+        # recycle tests deterministic)
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._allocated: set = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_pages(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> List[int]:
+        if n < 1:
+            raise ValueError(f"alloc of {n} pages")
+        if n > len(self._free):
+            raise ValueError(
+                f"pool exhausted: {n} pages requested, "
+                f"{len(self._free)} free")
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(
+                    f"double-free or foreign page {p} (allocated: "
+                    f"{sorted(self._allocated)})")
+            self._allocated.remove(p)
+            self._free.append(p)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeGraph:
+    """The decode step plus everything needed to compile and carry it.
+
+    ``fn(params, carry, tokens, active) -> (carry', outputs)``;
+    ``carry`` is donate_argnums=(1,) — every leaf aliases an output
+    (pools/lengths are updated in place, page_tables pass through),
+    so the step's HBM high-water mark is ONE copy of the cache.
+    """
+
+    model: object
+    fn: Callable
+    geometry: DecodeGeometry
+    policy: Policy
+    pool_dtype: object
+    num_kv_sets: int
+    head_dim: int
+    num_heads: int
+    vocab_size: int
+    donate_argnums: tuple = (1,)
+    output_names: tuple = ("next_token", "topk_ids", "topk_scores")
+
+    def init_params(self, seed: int = 0):
+        import jax
+
+        return self.model.init(jax.random.key(seed))
+
+    def init_carry(self) -> Dict[str, object]:
+        import jax.numpy as jnp
+
+        g = self.geometry
+        pool = (g.num_pages, g.page_size, self.num_heads, self.head_dim)
+        kv = {}
+        for name in (("k1", "v1") if self.num_kv_sets == 1
+                     else ("k1", "v1", "kn", "vn")):
+            kv[name] = jnp.zeros(pool, self.pool_dtype)
+        return {
+            "kv": kv,
+            "lengths": jnp.zeros((g.max_streams,), jnp.int32),
+            "page_tables": jnp.zeros(
+                (g.max_streams, g.pages_per_stream), jnp.int32),
+        }
+
+
+def build_decode_graph(model, geometry: DecodeGeometry, *,
+                       policy: Policy = DEFAULT_POLICY,
+                       attn_impl: str = "pallas") -> DecodeGraph:
+    """Build the decode step for a ``PerceiverMLM``-shaped model.
+
+    ``attn_impl``: ``"pallas"`` is the production kernel (interpret
+    mode on CPU); ``"reference"`` the pure-jax gather path — the
+    sharded (dp2×tp2) canonical target lowers the reference because
+    GSPMD partitions gathers/einsums, not Pallas calls.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_tpu.models.perceiver import (
+        cross_attention_layer_apply,
+        self_attention_block_apply,
+    )
+    from perceiver_tpu.ops.attention import cross_attention_kv
+    from perceiver_tpu.ops.linear import linear_apply
+    from perceiver_tpu.ops.mlp import mlp_apply
+    from perceiver_tpu.ops.norm import layer_norm_apply
+    from perceiver_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        paged_decode_attention_reference,
+    )
+
+    if attn_impl not in ("pallas", "reference"):
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+    encoder, decoder = model.encoder, model.decoder
+    n_lat, channels = encoder.latent_shape
+    enc_heads = encoder.num_cross_attention_heads
+    dec_heads = decoder.num_cross_attention_heads
+    n_layers = encoder.num_layers
+    model_max_seq = decoder.output_adapter.output_shape[0]
+    if geometry.max_seq_len > model_max_seq:
+        raise ValueError(
+            f"geometry.max_seq_len {geometry.max_seq_len} exceeds the "
+            f"model's position table {model_max_seq}")
+    if channels % enc_heads:
+        raise ValueError(
+            f"channels {channels} not divisible by num heads {enc_heads}")
+    head_dim = channels // enc_heads
+    r = geometry.max_streams
+    ps = geometry.page_size
+    pps = geometry.pages_per_stream
+    max_seq = geometry.max_seq_len
+    pool_dtype = policy.compute_dtype
+    vocab = decoder.output_adapter.num_classes \
+        if hasattr(decoder.output_adapter, "num_classes") else None
+    attn = (paged_decode_attention if attn_impl == "pallas"
+            else paged_decode_attention_reference)
+    # flat-gather index base for the per-stream page lookup (static)
+    row_base = jnp.arange(r, dtype=jnp.int32) * pps
+
+    def fn(params, carry, tokens, active):
+        enc_p = params["encoder"]
+        lengths = carry["lengths"]
+        tables = carry["page_tables"]
+        pos = jnp.clip(lengths, 0, max_seq - 1)
+
+        # 1. embed the incoming token of every slot at its position
+        emb = encoder.input_adapter.apply_packed(
+            enc_p["input_adapter"], tokens, pos, policy=policy)  # (R, C)
+
+        # 2. the O(1) cache update: scatter this token's K/V into its
+        # stream's current page; inactive slots write the trash page
+        page = jnp.take(tables.reshape(-1), row_base + pos // ps)
+        page = jax.lax.select(active, page, jnp.zeros_like(page))
+        slot = pos % ps
+
+        def append(layer_params, kpool, vpool):
+            kh, vh = cross_attention_kv(
+                layer_params["cross"]["attn"], emb[None],
+                num_heads=enc_heads, policy=policy)  # (1, R, H, Dh)
+            kpool = kpool.at[page, slot].set(kh[0].astype(kpool.dtype))
+            vpool = vpool.at[page, slot].set(vh[0].astype(vpool.dtype))
+            return kpool, vpool
+
+        kv = dict(carry["kv"])
+        kv["k1"], kv["v1"] = append(enc_p["layer_1"], kv["k1"], kv["v1"])
+        if n_layers > 1:
+            kv["kn"], kv["vn"] = append(enc_p["layer_n"],
+                                        kv["kn"], kv["vn"])
+        new_lengths = lengths + active.astype(lengths.dtype)
+
+        # 3. latents from scratch over the paged pools — mirrors
+        # serving/graphs._packed_encoder_apply with the ragged kernel
+        # swapped for the paged one
+        def one_layer(layer_params, kpool, vpool, lat):
+            attn_p = layer_params["cross"]["attn"]
+            xq = layer_norm_apply(attn_p["norm_q"], lat, policy=policy)
+            qh = linear_apply(attn_p["mha"]["q"], xq, policy=policy)
+            q = qh.reshape(r, n_lat, enc_heads, head_dim).transpose(
+                0, 2, 1, 3)
+            o = attn(q, kpool, vpool, tables, new_lengths,
+                     scale=1.0 / (head_dim ** 0.5))
+            o = o.transpose(0, 2, 1, 3).reshape(r, n_lat,
+                                                enc_heads * head_dim)
+            o = linear_apply(attn_p["mha"]["out"], o, policy=policy)
+            y = lat + o
+            y = y + mlp_apply(layer_params["cross"]["mlp"], y,
+                              policy=policy)
+            return self_attention_block_apply(
+                layer_params["selfs"], y,
+                num_heads=encoder.num_self_attention_heads,
+                policy=policy)
+
+        latent = jnp.broadcast_to(
+            policy.cast_param(enc_p["latent"])[None],
+            (r, n_lat, channels))
+        latent = one_layer(enc_p["layer_1"], kv["k1"], kv["v1"], latent)
+        if n_layers > 1:
+            layer_n = enc_p["layer_n"]
+
+            def body(c, _):
+                return one_layer(layer_n, kv["kn"], kv["vn"],
+                                 policy.cast_compute(c)), None
+
+            latent, _ = jax.lax.scan(body, latent, None,
+                                     length=n_layers - 1)
+
+        # 4. decode ONE query row per stream: the next position
+        pd = params["decoder"]
+        qpos = jnp.clip(new_lengths, 0, max_seq - 1)
+        query = jnp.take(policy.cast_param(pd["query"]), qpos,
+                         axis=0)[:, None, :]  # (R, 1, C)
+        hidden = cross_attention_layer_apply(
+            pd["cross"], query, latent, num_heads=dec_heads,
+            policy=policy)
+        logits = linear_apply(pd["output_adapter"]["linear"], hidden,
+                              policy=policy)[:, 0]  # (R, V)
+        scores, topk_ids = jax.lax.top_k(
+            logits.astype(jnp.float32), geometry.top_k)
+        carry_out = {"kv": kv, "lengths": new_lengths,
+                     "page_tables": tables}
+        return carry_out, {
+            "next_token": topk_ids[:, 0].astype(jnp.int32),
+            "topk_ids": topk_ids.astype(jnp.int32),
+            "topk_scores": scores,
+        }
+
+    return DecodeGraph(
+        model=model, fn=fn, geometry=geometry, policy=policy,
+        pool_dtype=pool_dtype,
+        num_kv_sets=1 if n_layers == 1 else 2,
+        head_dim=head_dim, num_heads=enc_heads,
+        vocab_size=vocab if vocab is not None else -1)
+
+
+# --- streams -----------------------------------------------------------------
+
+_SENTINEL = object()
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeResult:
+    """One finished stream: generated ids + timing."""
+
+    tokens: List[int]
+    prompt_len: int
+    finished: str                 # "complete" | "cancelled"
+    ttft_s: Optional[float]
+
+
+class _Stream:
+    """Engine-internal per-stream state (guarded by the engine lock)."""
+
+    __slots__ = ("sid", "prompt", "max_new", "pages_needed", "on_token",
+                 "ctx", "enqueued_at", "deadline", "slot", "pages",
+                 "fed", "next_input", "generated", "tokens_q", "done",
+                 "outcome", "error", "ttft_s", "submitted_at")
+
+    def __init__(self, sid, prompt, max_new, pages_needed, on_token,
+                 ctx, now, deadline):
+        self.sid = sid
+        self.prompt = prompt
+        self.max_new = max_new
+        self.pages_needed = pages_needed
+        self.on_token = on_token
+        self.ctx = ctx
+        self.enqueued_at = now
+        self.submitted_at = now
+        self.deadline = deadline
+        self.slot = -1
+        self.pages: List[int] = []
+        self.fed = 0
+        self.next_input = int(prompt[0])
+        self.generated: List[int] = []
+        self.tokens_q: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+        self.done = threading.Event()
+        self.outcome = None           # DecodeResult | Overloaded
+        self.error: Optional[BaseException] = None
+        self.ttft_s: Optional[float] = None
+
+
+class StreamHandle:
+    """Caller-facing handle for one submitted stream.
+
+    ``tokens()`` is a blocking iterator over generated token ids (ends
+    when the stream finishes); ``result(timeout)`` blocks for the
+    final :class:`DecodeResult` — or a typed
+    :class:`~perceiver_tpu.serving.batcher.Overloaded` value when the
+    stream was shed, following the batcher's value-not-exception
+    convention. Stream errors re-raise here.
+    """
+
+    def __init__(self, stream: _Stream, engine: "DecodeEngine"):
+        self._stream = stream
+        self._engine = engine
+        self.trace_ctx = stream.ctx
+
+    @property
+    def stream_id(self) -> str:
+        return self._stream.sid
+
+    def tokens(self):
+        while True:
+            tok = self._stream.tokens_q.get()
+            if tok is _SENTINEL:
+                return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._stream.done.wait(timeout):
+            raise TimeoutError(
+                f"stream {self._stream.sid} unfinished after {timeout}s")
+        if self._stream.error is not None:
+            raise self._stream.error
+        return self._stream.outcome
+
+    def done(self) -> bool:
+        return self._stream.done.is_set()
+
+    def cancel(self) -> bool:
+        return self._engine._cancel(self._stream)
+
+
+class DecodeEngine:
+    """The stepped decode executor: ONE AOT-compiled signature, a
+    shared paged KV pool, streams joining and leaving mid-flight.
+
+    ``auto_step=True`` (default) runs a worker thread that steps
+    whenever work exists; tests pass ``auto_step=False`` and drive
+    :meth:`step` / :meth:`run_until_idle` deterministically.
+    """
+
+    def __init__(self, task, params=None, *,
+                 geometry: DecodeGeometry,
+                 policy: Policy = DEFAULT_POLICY,
+                 attn_impl: str = "pallas",
+                 exec_cache=None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 max_queue: int = 64,
+                 auto_step: bool = True,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.task = task
+        self.geometry = geometry
+        self.policy = policy
+        self.exec_cache = resolve_exec_cache(exec_cache)
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.graph = build_decode_graph(
+            task.build(), geometry, policy=policy, attn_impl=attn_impl)
+        self.params = params if params is not None \
+            else self.graph.init_params(seed)
+
+        m = self.metrics
+        self._m_active = m.gauge(
+            "serving_decode_streams_active",
+            "decode streams currently holding a slot")
+        self._m_free_pages = m.gauge(
+            "serving_decode_free_pages", "allocatable pages not in use")
+        self._m_steps = m.counter(
+            "serving_decode_steps_total", "decode step executions")
+        self._m_tokens = m.counter(
+            "serving_decode_tokens_total", "generated tokens emitted")
+        self._m_streams = m.counter(
+            "serving_decode_streams_total", "finished streams by outcome")
+        self._m_shed = m.counter(
+            "serving_decode_shed_total", "streams shed by reason")
+        self._m_ttft = m.histogram(
+            "serving_decode_ttft_seconds",
+            "submit → first generated token")
+        self._m_step_latency = m.histogram(
+            "serving_decode_step_latency_seconds",
+            "one decode step (dispatch + next_token sync)")
+
+        r = geometry.max_streams
+        self.pool = PagePool(geometry.num_pages, geometry.page_size)
+        self._m_free_pages.set(self.pool.free_pages)
+        self._queue = AdmissionQueue(max_depth=max_queue, metrics=m)
+        self._streams: List[Optional[_Stream]] = [None] * r
+        self._tables = np.zeros((r, geometry.pages_per_stream), np.int32)
+        self._lengths = np.zeros((r,), np.int32)
+        self._dirty = False
+        self._seq = 0
+        self._closed = False
+        self._failed: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+
+        tokens0 = jnp.zeros((r,), jnp.int32)
+        active0 = jnp.zeros((r,), jnp.bool_)
+        jitted = jax.jit(self.graph.fn,
+                         donate_argnums=self.graph.donate_argnums)
+        carry = self.graph.init_carry()
+        self._exe, info = aot_compile(
+            jitted, (self.params, carry, tokens0, active0),
+            cache=self.exec_cache,
+            donate_argnums=self.graph.donate_argnums,
+            label=f"decode:{geometry.descriptor}",
+            extra_key=(geometry.descriptor,))
+        if self.exec_cache is not None:
+            events_mod.emit("exec_cache",
+                            bucket=f"decode:{geometry.descriptor}",
+                            hit=bool(info["hit"]))
+        # warmup step with every slot inactive: the steady state then
+        # re-runs an already-warm executable — zero per-step compiles
+        carry, out = self._exe(self.params, carry, tokens0, active0)
+        np.asarray(out["next_token"])
+        self._carry = carry
+
+        self._worker: Optional[threading.Thread] = None
+        if auto_step:
+            self._worker = threading.Thread(
+                target=self._loop, name="decode-engine", daemon=True)
+            self._worker.start()
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, prompt_ids, *, max_new_tokens: int,
+               timeout_ms: Optional[float] = None,
+               on_token: Optional[Callable[[int], None]] = None,
+               trace: Optional[trace_mod.TraceContext] = None
+               ) -> StreamHandle:
+        """Enqueue one stream. Raises :class:`RequestTooLarge` when the
+        request can never fit this engine's geometry; resolves the
+        handle to a typed ``Overloaded`` when capacity is transiently
+        unavailable (queue full / admission deadline)."""
+        g = self.geometry
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        vocab = self.graph.vocab_size
+        if vocab > 0 and (prompt.min() < 0 or prompt.max() >= vocab):
+            raise ValueError(
+                f"prompt ids outside [0, {vocab}) — not a valid token "
+                "sequence for this model")
+        total = int(prompt.size) + int(max_new_tokens)
+        if total > g.max_seq_len:
+            raise RequestTooLarge(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds the decode "
+                f"geometry's max_seq_len {g.max_seq_len}")
+        # the last generated token is never fed back, so the cache
+        # holds total - 1 tokens at finish
+        pages_needed = g.pages_for(total - 1)
+        if pages_needed > g.allocatable_pages:
+            raise RequestTooLarge(
+                f"request needs {pages_needed} pages, pool has only "
+                f"{g.allocatable_pages} allocatable "
+                f"({g.num_pages} minus the reserved trash page)")
+        now = time.monotonic()
+        ctx = trace if trace is not None \
+            else trace_mod.start_trace(origin="decode")
+        deadline = (now + timeout_ms / 1000.0
+                    if timeout_ms is not None else None)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("decode engine is closed")
+            if self._failed is not None:
+                raise Unavailable("decode_engine_failed")
+            self._seq += 1
+            stream = _Stream(f"s{self._seq}", prompt, int(max_new_tokens),
+                             pages_needed, on_token, ctx, now, deadline)
+            handle = StreamHandle(stream, self)
+            if not self._queue.offer(stream, cost=pages_needed,
+                                     deadline=deadline):
+                self._m_shed.labels(reason="queue_full").inc()
+                self._resolve_shed(stream, Overloaded(
+                    "queue_full", self._queue.depth))
+                return handle
+            self._work.notify_all()
+        return handle
+
+    # -- stepping ---------------------------------------------------------
+
+    def _admit_locked(self, now: float) -> None:
+        free_slots = sum(1 for s in self._streams if s is None)
+        admitted, shed = self._queue.take(
+            budget=self.pool.free_pages, slots=free_slots, now=now)
+        for stream in shed:
+            self._m_shed.labels(reason="deadline").inc()
+            self._resolve_shed(stream, Overloaded(
+                "deadline", self._queue.depth))
+        for stream in admitted:
+            slot = next(i for i, s in enumerate(self._streams)
+                        if s is None)
+            stream.slot = slot
+            stream.pages = self.pool.alloc(stream.pages_needed)
+            self._streams[slot] = stream
+            self._tables[slot, :] = 0
+            self._tables[slot, :len(stream.pages)] = stream.pages
+            self._lengths[slot] = 0
+            self._dirty = True
+            if stream.ctx is not None:
+                stream.ctx.record("queue_wait", start=stream.enqueued_at,
+                                  end=now, stream=stream.sid)
+            events_mod.emit("stream_open", stream=stream.sid)
+            self._m_active.set(
+                sum(1 for s in self._streams if s is not None))
+            self._m_free_pages.set(self.pool.free_pages)
+
+    def step(self) -> int:
+        """Run one decode step over every occupied slot (admitting
+        queued streams first). Returns the number of active streams
+        stepped — 0 means idle. Emits/finishes streams as a side
+        effect; callbacks fire outside the engine lock."""
+        import jax.numpy as jnp
+
+        emits: List[tuple] = []
+        finished: List[_Stream] = []
+        with self._lock:
+            if self._failed is not None:
+                raise Unavailable("decode_engine_failed")
+            t0 = time.monotonic()
+            self._admit_locked(t0)
+            live = [(i, s) for i, s in enumerate(self._streams)
+                    if s is not None]
+            if not live:
+                return 0
+            r = self.geometry.max_streams
+            tokens = np.zeros((r,), np.int32)
+            active = np.zeros((r,), bool)
+            for i, s in live:
+                tokens[i] = s.next_input
+                active[i] = True
+            carry = self._carry
+            self._carry = None  # donated: loud failure on re-entry
+            if self._dirty:
+                carry["page_tables"] = jnp.asarray(self._tables)
+                carry["lengths"] = jnp.asarray(self._lengths)
+                self._dirty = False
+            try:
+                carry, out = self._exe(self.params, carry,
+                                       jnp.asarray(tokens),
+                                       jnp.asarray(active))
+                # the one deliberate sync of the decode path
+                next_tok = np.asarray(out["next_token"])
+            except Exception as e:
+                self._fail_locked(e)
+                raise
+            t1 = time.monotonic()
+            self._carry = carry
+            self._lengths[active] += 1
+            self._m_steps.inc()
+            self._m_step_latency.observe(t1 - t0)
+            for i, s in live:
+                if s.ctx is not None:
+                    s.ctx.record("decode_step", start=t0, end=t1,
+                                 stream=s.sid)
+                s.fed += 1
+                if s.fed < len(s.prompt):
+                    s.next_input = int(s.prompt[s.fed])
+                    continue
+                tok = int(next_tok[i])
+                s.generated.append(tok)
+                s.next_input = tok
+                if s.ttft_s is None:
+                    s.ttft_s = t1 - s.submitted_at
+                    self._m_ttft.observe(s.ttft_s)
+                if s.ctx is not None:
+                    s.ctx.record("token_emit", start=t1, end=t1,
+                                 stream=s.sid,
+                                 index=len(s.generated) - 1)
+                self._m_tokens.inc()
+                emits.append((s, tok))
+                if len(s.generated) >= s.max_new:
+                    self._finish_locked(s, "complete")
+                    finished.append(s)
+            self._work.notify_all()
+        for s, tok in emits:
+            s.tokens_q.put(tok)
+            if s.on_token is not None:
+                try:
+                    s.on_token(tok)
+                except Exception as e:  # noqa: BLE001 — fail the stream, not the loop
+                    self._cancel(s, error=e)
+        for s in finished:
+            s.tokens_q.put(_SENTINEL)
+            s.done.set()
+        return len(live)
+
+    def run_until_idle(self, max_steps: int = 10_000) -> int:
+        """Step until no stream is active or queued (deterministic
+        test driver). Returns steps executed."""
+        for n in range(max_steps):
+            if self.step() == 0:
+                return n
+        raise RuntimeError(f"not idle after {max_steps} steps")
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while (not self._closed and self._failed is None
+                       and not self._has_work_locked()):
+                    self._work.wait(0.05)
+                if self._closed or self._failed is not None:
+                    return
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — streams already failed typed
+                return
+
+    def _has_work_locked(self) -> bool:
+        return (self._queue.depth > 0
+                or any(s is not None for s in self._streams))
+
+    # -- lifecycle / resolution -------------------------------------------
+
+    def _finish_locked(self, s: _Stream, how: str) -> None:
+        if s.slot >= 0:
+            self.pool.free(s.pages)
+            self._streams[s.slot] = None
+            self._tables[s.slot, :] = 0
+            self._lengths[s.slot] = 0
+            self._dirty = True
+            self._m_active.set(
+                sum(1 for st in self._streams if st is not None))
+            self._m_free_pages.set(self.pool.free_pages)
+        events_mod.emit("stream_close", stream=s.sid,
+                        tokens=len(s.generated))
+        self._m_streams.labels(outcome=how).inc()
+        s.outcome = DecodeResult(
+            tokens=list(s.generated), prompt_len=len(s.prompt),
+            finished=how, ttft_s=s.ttft_s)
+
+    def _resolve_shed(self, s: _Stream, overloaded: Overloaded) -> None:
+        self._m_streams.labels(outcome="shed").inc()
+        s.outcome = overloaded
+        s.tokens_q.put(_SENTINEL)
+        s.done.set()
+
+    def _cancel(self, s: _Stream,
+                error: Optional[BaseException] = None) -> bool:
+        with self._lock:
+            if s.done.is_set() or s.outcome is not None:
+                return False
+            if s.slot < 0:
+                self._queue.remove(s)
+            self._finish_locked(s, "cancelled")
+            s.error = error
+            self._work.notify_all()
+        s.tokens_q.put(_SENTINEL)
+        s.done.set()
+        return True
+
+    def _fail_locked(self, e: BaseException) -> None:
+        """A step blew up mid-flight: the donated carry may be gone,
+        so the engine is dead — fail every stream typed, never hang
+        a caller on a future that cannot resolve."""
+        self._failed = e
+        err = e if isinstance(e, (Unavailable, BatchError)) else \
+            BatchError(f"decode step failed: {type(e).__name__}: {e}",
+                       cause=e)
+        leftovers = [s for s in self._streams if s is not None]
+        for s in leftovers:
+            self._streams[s.slot] = None
+        for s in self._queue.drain_all():
+            leftovers.append(s)
+        for s in leftovers:
+            s.error = err
+            s.tokens_q.put(_SENTINEL)
+            s.done.set()
+        self._work.notify_all()
+
+    def update_params(self, params) -> None:
+        """Swap weights recompile-free — same treedef/shapes → same
+        compiled step. Callers quiesce first (the replica cutover's
+        inflight guard covers decode dispatches end-to-end); a stream
+        admitted after the swap generates entirely under the new tree,
+        so no stream ever mixes KV from two versions."""
+        import jax
+
+        with self._lock:
+            self.params = jax.device_put(params)
+
+    @property
+    def active_streams(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._streams if s is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._queue.depth
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted stream finished."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._work:
+            while self._has_work_locked():
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+                self._work.wait(0.05)
+        return True
+
+    def metrics_text(self) -> str:
+        return self.metrics.render()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain, then stop the worker. Streams still unfinished past
+        ``timeout`` resolve with a typed ``Unavailable``."""
+        with self._lock:
+            if self._closed:
+                return
+        self.drain(timeout)
+        with self._lock:
+            self._closed = True
+            self._work.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+        with self._lock:
+            stranded = [s for s in self._streams if s is not None]
+            for s in self._streams:
+                if s is not None:
+                    self._streams[s.slot] = None
+            stranded.extend(self._queue.drain_all())
+        err = Unavailable("shutting_down")
+        for s in stranded:
+            s.error = err
+            s.tokens_q.put(_SENTINEL)
+            s.done.set()

@@ -78,6 +78,22 @@ class RequestTooLarge(ValueError):
     """Request exceeds every configured bucket on some axis."""
 
 
+def resolve_exec_cache(exec_cache) -> Optional[ExecutableCache]:
+    """The engines' shared persistent-compile-cache knob: ``None``
+    resolves the process default (the ``PERCEIVER_EXEC_CACHE`` env
+    dir), a ``str`` opens that directory, ``False`` disables caching
+    even when the env var is set, and an ``ExecutableCache`` passes
+    through. Used by :class:`ServingEngine` and the decode engine
+    (``serving/decode.py``) so both read the same configuration."""
+    if exec_cache is None:
+        return default_cache()
+    if exec_cache is False:
+        return None
+    if isinstance(exec_cache, str):
+        return default_cache(exec_cache)
+    return exec_cache
+
+
 @dataclasses.dataclass(frozen=True)
 class ServeResult:
     """One dispatched bucket call, still on device.
@@ -132,16 +148,8 @@ class ServingEngine:
                  breaker_failure_threshold: int = 5,
                  breaker_reset_s: float = 30.0,
                  breaker_clock=time.monotonic):
-        # persistent compile cache: None resolves the process default
-        # (the PERCEIVER_EXEC_CACHE env dir); a str opens that dir;
-        # False disables caching even when the env var is set
-        if exec_cache is None:
-            exec_cache = default_cache()
-        elif exec_cache is False:
-            exec_cache = None
-        elif isinstance(exec_cache, str):
-            exec_cache = default_cache(exec_cache)
-        self.exec_cache: Optional[ExecutableCache] = exec_cache
+        self.exec_cache: Optional[ExecutableCache] = \
+            resolve_exec_cache(exec_cache)
         self.task = task
         if graph is None:
             if task is None:

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Streaming-decode load generator: the O(1) paged-KV merge gate.
+
+Drives a ``DecodeEngine`` with a churning open-loop workload — streams
+with varied lengths join and leave mid-flight, so the engine's slot
+occupancy, page allocation, and admission queue all cycle while the
+ONE stepped executable keeps replaying. Emits a ``bench.py``-format
+result line::
+
+    {"metric": "decode_tokens_per_sec", "value": ..., "unit":
+     "tokens/s", "vs_baseline": null, "detail": {"p50_ms": ...,
+     "ttft_p50_ms": ..., "o1_ratio": ..., ...}}
+
+Two hard gates, each an ``exit 1``:
+
+- **O(1) per-token cost** — the p95 inter-token gap at each stream's
+  LAST token must stay within ``--gate-ratio`` (default 1.15×) of the
+  p95 gap at token 10. Paged attention reads the same page-table-bound
+  footprint at every position; any per-position growth (quadratic
+  recompute, cache copies) shows up here.
+- **Zero post-warmup XLA compiles** (``jax.monitoring``) — streams
+  joining/leaving must never change the step signature; a mid-traffic
+  compile is a geometry-bucketing bug.
+
+Runs on any backend; on CPU use ``--preset tiny`` (the default), which
+decodes a test-sized model — the point of the CPU run is the gate
+pair, not throughput. On a chip, drop ``--preset tiny`` for the
+canonical MLM shapes (the ``decode_mlm_r8_p64x16`` target geometry).
+
+Examples::
+
+    JAX_PLATFORMS=cpu python scripts/bench_decode.py
+    JAX_PLATFORMS=cpu python scripts/bench_decode.py --streams 12 \
+        --max-new-min 20 --max-new-max 40
+    python scripts/bench_decode.py --preset full --streams 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _tiny_decode_task(max_seq_len: int):
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+    return MaskedLanguageModelTask(
+        vocab_size=110, max_seq_len=max_seq_len, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+
+
+def _full_decode_task(max_seq_len: int):
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+    return MaskedLanguageModelTask(vocab_size=10003,
+                                   max_seq_len=max_seq_len)
+
+
+@contextlib.contextmanager
+def _compile_events():
+    """Collect XLA compile events (jax.monitoring) inside the block."""
+    import jax
+    from jax._src import monitoring as _monitoring
+
+    events = []
+
+    def listener(name, **kwargs):
+        if "compile" in name:
+            events.append(name)
+
+    jax.monitoring.register_event_listener(listener)
+    try:
+        yield events
+    finally:
+        _monitoring._unregister_event_listener_by_callback(listener)
+
+
+def _pct(values, q):
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="streaming decode bench: O(1) paged-KV gate")
+    ap.add_argument("--preset", choices=("tiny", "full"),
+                    default="tiny",
+                    help="tiny = CPU-sized model (default); full = "
+                         "canonical MLM shapes for a chip run")
+    ap.add_argument("--streams", type=int, default=24,
+                    help="total streams to push through (default 24)")
+    ap.add_argument("--max-new-min", type=int, default=40)
+    ap.add_argument("--max-new-max", type=int, default=120)
+    ap.add_argument("--prompt-len", type=int, default=8)
+    ap.add_argument("--gate-ratio", type=float, default=1.15,
+                    help="p95(last token) must be <= ratio * "
+                         "p95(token 10)")
+    ap.add_argument("--gate-token", type=int, default=10,
+                    help="early token index the gate compares against")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None,
+                    help="also write the result JSON to this path")
+    args = ap.parse_args()
+
+    from perceiver_tpu.serving.decode import DecodeEngine, DecodeGeometry
+
+    if args.max_new_min <= args.gate_token:
+        ap.error("--max-new-min must exceed --gate-token so every "
+                 "stream contributes an early-token sample")
+
+    max_seq = args.prompt_len + args.max_new_max
+    if args.preset == "tiny":
+        task = _tiny_decode_task(max_seq)
+        geometry = DecodeGeometry(max_streams=8, num_pages=81,
+                                  page_size=16, max_seq_len=max_seq)
+    else:
+        task = _full_decode_task(max(512, max_seq))
+        geometry = DecodeGeometry(max_streams=8, num_pages=81,
+                                  page_size=16,
+                                  max_seq_len=max(512, max_seq))
+
+    rng = np.random.default_rng(args.seed)
+    vocab = task.vocab_size
+    plans = [
+        (rng.integers(3, vocab, (args.prompt_len,)).astype(np.int32),
+         int(rng.integers(args.max_new_min, args.max_new_max + 1)))
+        for _ in range(args.streams)
+    ]
+
+    t_build = time.monotonic()
+    engine = DecodeEngine(task, geometry=geometry, auto_step=True,
+                          max_queue=args.streams + 1)
+    print(f"[bench_decode] engine up in "
+          f"{time.monotonic() - t_build:.1f}s — geometry "
+          f"{geometry.descriptor}", flush=True)
+
+    # per-stream emit timestamps; index in the list == token index
+    emit_times = [[] for _ in plans]
+
+    def tracker(i):
+        def on_token(tok):
+            emit_times[i].append(time.monotonic())
+        return on_token
+
+    t0 = time.monotonic()
+    with _compile_events() as compiles:
+        handles = []
+        for i, (prompt, max_new) in enumerate(plans):
+            # stagger arrivals: a fresh stream joins roughly every
+            # half-stream lifetime, so slots churn (join/leave
+            # mid-flight) instead of running in lockstep waves
+            handles.append(engine.submit(prompt,
+                                         max_new_tokens=max_new,
+                                         on_token=tracker(i)))
+            time.sleep(0.01)
+        results = [h.result(timeout=600.0) for h in handles]
+    wall = time.monotonic() - t0
+    engine.close()
+
+    total_tokens = sum(len(r.tokens) for r in results)
+    for (prompt, max_new), r in zip(plans, results):
+        assert r.finished == "complete", r
+        assert len(r.tokens) == max_new
+
+    gaps_ms, early_ms, last_ms = [], [], []
+    for times in emit_times:
+        gaps = 1e3 * np.diff(np.asarray(times))
+        gaps_ms.extend(gaps.tolist())
+        # gap index g is the interval before token g+1
+        if len(gaps) > args.gate_token:
+            early_ms.append(float(gaps[args.gate_token - 1]))
+        last_ms.append(float(gaps[-1]))
+    ttft_ms = [1e3 * r.ttft_s for r in results]
+
+    p95_early = _pct(early_ms, 95)
+    p95_last = _pct(last_ms, 95)
+    o1_ratio = p95_last / p95_early
+    gate_ok = o1_ratio <= args.gate_ratio
+    compiles_ok = len(compiles) == 0
+
+    import jax
+    dev = jax.devices()[0]
+    result = {
+        "metric": "decode_tokens_per_sec",
+        "value": round(total_tokens / wall, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "detail": {
+            "preset": args.preset,
+            "geometry": geometry.descriptor,
+            "streams": args.streams,
+            "prompt_len": args.prompt_len,
+            "max_new_range": [args.max_new_min, args.max_new_max],
+            "total_tokens": total_tokens,
+            "wall_s": round(wall, 2),
+            "p50_ms": round(_pct(gaps_ms, 50), 3),
+            "p95_ms": round(_pct(gaps_ms, 95), 3),
+            "p99_ms": round(_pct(gaps_ms, 99), 3),
+            "ttft_p50_ms": round(_pct(ttft_ms, 50), 3),
+            "ttft_p95_ms": round(_pct(ttft_ms, 95), 3),
+            f"p95_token{args.gate_token}_ms": round(p95_early, 3),
+            "p95_last_token_ms": round(p95_last, 3),
+            "o1_ratio": round(o1_ratio, 4),
+            "o1_gate": args.gate_ratio,
+            "post_warmup_compiles": len(compiles),
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+        },
+    }
+    line = json.dumps(result)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not compiles_ok:
+        print(f"[bench_decode] FAIL: {len(compiles)} post-warmup XLA "
+              f"compile(s) — streams joining/leaving changed the step "
+              f"signature: {compiles[:5]}", file=sys.stderr)
+    if not gate_ok:
+        print(f"[bench_decode] FAIL: p95 at last token "
+              f"{p95_last:.3f}ms > {args.gate_ratio}x p95 at token "
+              f"{args.gate_token} ({p95_early:.3f}ms) — per-token cost "
+              f"is growing with position", file=sys.stderr)
+    return 0 if (gate_ok and compiles_ok) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -270,6 +270,41 @@ class TestLoweringRecords:
         assert cache.load_lowering(k41)["compiled_text"] == \
             "HloModule m41"
 
+    def test_executable_key_forks_on_pool_geometry(self, tmp_path):
+        """ISSUE 14: the decode engine's AOT key carries the pool
+        geometry descriptor (``DecodeGeometry.descriptor``) as key
+        extra — two engines with different page counts or page sizes
+        must never share an executable, even if a future refactor made
+        their HLO coincide (the page-table ABI differs: table width and
+        page-index range are geometry-bound host-side contracts)."""
+        from perceiver_tpu.serving.decode import DecodeGeometry
+
+        cache = _cache(tmp_path)
+        geoms = (
+            DecodeGeometry(max_streams=8, num_pages=64, page_size=16,
+                           max_seq_len=512),
+            DecodeGeometry(max_streams=8, num_pages=32, page_size=16,
+                           max_seq_len=512),   # fewer pages
+            DecodeGeometry(max_streams=8, num_pages=64, page_size=8,
+                           max_seq_len=512),   # narrower pages
+            DecodeGeometry(max_streams=4, num_pages=64, page_size=16,
+                           max_seq_len=512),   # fewer slots
+        )
+        descriptors = {g.descriptor for g in geoms}
+        assert len(descriptors) == 4, \
+            "geometry descriptor must distinguish slots/pages/page size"
+        text = "module @decode_step {}"  # same HLO for every key
+        keys = {cache.executable_key(text, donate_argnums=(1,),
+                                     extra=(g.descriptor,))
+                for g in geoms}
+        assert len(keys) == 4, "pool geometry must be key material"
+        # identical geometry still dedupes to one key (warm restart hit)
+        again = DecodeGeometry(max_streams=8, num_pages=64,
+                               page_size=16, max_seq_len=512)
+        assert cache.executable_key(
+            text, donate_argnums=(1,),
+            extra=(again.descriptor,)) in keys
+
 
 class TestStepFlopsCachePath:
     def test_hit_returns_sidecar_flops_and_executable(self, tmp_path):

@@ -27,7 +27,7 @@ from perceiver_tpu.fleet.rpc import (
 )
 from perceiver_tpu.resilience.breaker import CLOSED, OPEN
 from perceiver_tpu.serving import RequestTooLarge
-from perceiver_tpu.serving.errors import Unavailable
+from perceiver_tpu.serving.errors import BatchError, Unavailable
 from perceiver_tpu.training.checkpoint import (
     CORRUPT,
     VERIFIED,
@@ -580,6 +580,89 @@ def test_router_routes_packed_payloads_over_real_replica():
                     np.int32),
                 "row_offsets": np.asarray([0, 20], np.int32),
                 "lengths": np.asarray([20, 20], np.int32)})
+    finally:
+        handle.close()
+        replica.close()
+
+
+def test_replica_serves_decode_payloads_over_rpc():
+    """ISSUE 14: a replica built with a ``decode`` spec serves
+    ``prompt_ids`` payloads through the router's normal dispatch path.
+    The decode plane shares the replica's params and metrics; the RPC
+    reply carries the generated tokens and TTFT (streaming stays
+    in-process — fleet RPC trades it for router retry/failover)."""
+    from perceiver_tpu.fleet.replica import ReplicaServer
+    from perceiver_tpu.fleet.supervisor import RpcReplicaHandle
+
+    spec = {
+        "task_class": "MaskedLanguageModelTask",
+        "task_kwargs": dict(
+            vocab_size=110, max_seq_len=32, num_latents=4,
+            num_latent_channels=8, num_encoder_layers=1,
+            num_encoder_self_attention_layers_per_block=1,
+            num_encoder_cross_attention_heads=1,
+            num_encoder_self_attention_heads=1,
+            num_decoder_cross_attention_heads=1, loss_impl="dense"),
+        "batch_buckets": [1],
+        "seq_buckets": [16],
+        "decode": {"max_streams": 2, "num_pages": 9, "page_size": 4,
+                   "max_seq_len": 32, "max_new_tokens_default": 4},
+    }
+    replica = ReplicaServer(spec)
+    handle = RpcReplicaHandle("127.0.0.1", replica.server.port,
+                              dispatch_timeout_s=60.0)
+    router, _ = make_router()
+    try:
+        router.add("r0", handle)
+        prompt = np.asarray([5, 9, 13], np.int32)
+        reply = router.submit({"prompt_ids": prompt,
+                               "max_new_tokens": np.asarray(6, np.int32)})
+        out = reply["outputs"]
+        assert out["tokens"].shape == (6,)
+        assert out["tokens"].dtype == np.int32
+        assert (out["tokens"] >= 0).all() and (out["tokens"] < 110).all()
+        assert float(out["ttft_s"]) >= 0.0
+        # omitting max_new_tokens falls back to the spec default (4)
+        reply2 = router.submit({"prompt_ids": prompt})
+        assert reply2["outputs"]["tokens"].shape == (4,)
+        # the same replica still serves rectangular payloads
+        rng = np.random.default_rng(0)
+        rect = router.submit({
+            "input_ids": rng.integers(3, 110, (1, 16)).astype(np.int32),
+            "pad_mask": np.zeros((1, 16), bool)})
+        assert rect["outputs"]["filled_ids"].shape == (1, 16)
+    finally:
+        handle.close()
+        replica.close()
+
+
+def test_replica_without_decode_rejects_prompt_payloads():
+    """A replica built WITHOUT a decode spec fails ``prompt_ids``
+    payloads deterministically (``BatchError`` over RPC), not as a
+    retryable transport error."""
+    from perceiver_tpu.fleet.replica import ReplicaServer
+    from perceiver_tpu.fleet.supervisor import RpcReplicaHandle
+
+    spec = {
+        "task_class": "MaskedLanguageModelTask",
+        "task_kwargs": dict(
+            vocab_size=110, max_seq_len=32, num_latents=4,
+            num_latent_channels=8, num_encoder_layers=1,
+            num_encoder_self_attention_layers_per_block=1,
+            num_encoder_cross_attention_heads=1,
+            num_encoder_self_attention_heads=1,
+            num_decoder_cross_attention_heads=1, loss_impl="dense"),
+        "batch_buckets": [1],
+        "seq_buckets": [16],
+    }
+    replica = ReplicaServer(spec)
+    handle = RpcReplicaHandle("127.0.0.1", replica.server.port,
+                              dispatch_timeout_s=60.0)
+    router, _ = make_router()
+    try:
+        router.add("r0", handle)
+        with pytest.raises(BatchError, match="decode"):
+            router.submit({"prompt_ids": np.asarray([5, 9], np.int32)})
     finally:
         handle.close()
         replica.close()

@@ -550,6 +550,96 @@ def test_packed_serve_bytes_pinned_below_padded_rect():
             f"below {rect_name} ({rect_bytes})")
 
 
+# --- decode targets (ISSUE 14) ----------------------------------------------
+
+
+def _tiny_decode_target(name="tiny_decode"):
+    def build():
+        from perceiver_tpu.serving.decode import DecodeGeometry
+
+        task = _tiny_mlm()
+        return task, {
+            "geometry": DecodeGeometry(max_streams=2, num_pages=5,
+                                       page_size=4, max_seq_len=16),
+            "tokens": jnp.asarray([7, 9], jnp.int32),
+            "active": jnp.ones((2,), jnp.bool_),
+        }
+
+    return StepTarget(name=name, build=build, kind="decode")
+
+
+def test_decode_targets_registered_and_budgeted():
+    """Both decode targets ride CANONICAL_TARGETS (check.py --all) and
+    carry pinned hbm budgets; the sharded variant is additionally
+    pinned in shard_budgets.json. An unbudgeted decode step would
+    silently opt the O(1)-memory claim out of the merge gate."""
+    from perceiver_tpu.analysis import DECODE_TARGETS, FAST_TARGETS
+    from perceiver_tpu.analysis.shardcheck import load_shard_budgets
+
+    names = {t.name for t in DECODE_TARGETS}
+    assert names == {"decode_mlm_r8_p64x16"}
+    assert all(t.kind == "decode" for t in DECODE_TARGETS)
+    canonical = {t.name for t in CANONICAL_TARGETS}
+    assert names <= canonical
+    spmd = "decode_mlm_spmd_r8_p48x16_dp2_tp2"
+    assert spmd in canonical
+    assert names | {spmd} <= set(load_hbm_budgets())
+    shard = load_shard_budgets()
+    assert spmd in shard and shard[spmd]["collectives"]
+    # the unsharded step is forward-only and compile-cheap: fast tier;
+    # the mesh variant pays an XLA compile, so --all/--graph only
+    fast = {t.name for t in FAST_TARGETS}
+    assert names <= fast and spmd not in fast
+
+
+def test_decode_step_donation_contract_lowered():
+    """The decode step donates exactly its carry — KV pools, lengths,
+    page tables (4 leaves at one encoder layer) — and lowering aliases
+    every leaf onto an output: the step's HBM high-water mark is ONE
+    copy of the paged cache, the property that makes token N cost the
+    same as token 1."""
+    lowered = lower_target(_tiny_decode_target())
+    assert lowered.expected_donated == 4  # k1, v1, lengths, page_tables
+    assert not donation_check(lowered.text, where="tiny_decode",
+                              expected_donated=lowered.expected_donated)
+    assert not transfer_guard(lowered.text, where="tiny_decode")
+
+
+def test_decode_target_recompile_closure():
+    """Independent rebuilds of the decode target lower byte-identically
+    — the engine compiles ONE step per pool geometry and replays it for
+    every token, so any signature drift would be a mid-stream
+    recompile (exactly what the zero-compile bench gate forbids)."""
+    violations, fp = recompile_budget(_tiny_decode_target())
+    assert not violations
+    assert fp
+
+
+def test_decode_hbm_budget_seeded_violation_through_runner(
+        tmp_path, monkeypatch, lowered_target_cache):
+    """Shrink the checked-in budget for the REGISTERED decode target
+    and the full runner must trip hbm_budget — the O(1)-memory pin is
+    an enforced merge gate, not a one-time measurement."""
+    import json as _json
+
+    import perceiver_tpu.analysis.passes as passes_mod
+    from perceiver_tpu.analysis import DECODE_TARGETS
+
+    target = DECODE_TARGETS[0]
+    with open(passes_mod._HBM_MANIFEST) as f:
+        manifest = _json.load(f)
+    manifest["targets"][target.name]["budget_bytes"] = 1
+    path = str(tmp_path / "budgets.json")
+    with open(path, "w") as f:
+        _json.dump(manifest, f)
+    monkeypatch.setattr(passes_mod, "_HBM_MANIFEST", path)
+    monkeypatch.setattr(passes_mod, "lower_target", lowered_target_cache)
+    report = run_graph_checks([target], recompile=False)
+    assert not report.ok
+    assert any(v.check == "hbm_budget" and v.where == target.name
+               for v in report.violations)
+
+
 # --- lint rules -------------------------------------------------------------
 
 

@@ -139,6 +139,31 @@ def test_event_schema_validation():
         validate_event({"type": "guard_skip", "step": 1})
 
 
+def test_decode_phases_and_stream_events_in_vocabulary():
+    """ISSUE 14: the decode plane speaks the closed observability
+    vocabulary — per-token trace phases (``decode_step`` spans the
+    batched device step, ``token_emit`` each stream's token delivery)
+    and stream lifecycle events (``stream_open``/``stream_close``).
+    A vocabulary miss would make DecodeEngine's tracing raise on the
+    first admitted stream."""
+    assert "decode_step" in trace_mod.PHASES
+    assert "token_emit" in trace_mod.PHASES
+    sink = SpanCollector()
+    ctx = trace_mod.start_trace(origin="decode", sink=sink)
+    ctx.record("decode_step", duration_s=0.001, live=3)
+    ctx.record("token_emit", duration_s=0.0, stream="s1", index=0)
+    assert [s["phase"] for s in sink.spans] == ["decode_step",
+                                                "token_emit"]
+
+    log = EventLog()
+    validate_event(log.emit("stream_open", stream="s1"))
+    validate_event(log.emit("stream_close", stream="s1", tokens=12))
+    with pytest.raises(ValueError, match="missing required"):
+        log.emit("stream_close", stream="s1")  # tokens is required
+    assert [e["type"] for e in log.events()] == ["stream_open",
+                                                 "stream_close"]
+
+
 def test_event_log_ring_and_jsonl_mirror(tmp_path):
     path = str(tmp_path / "events.jsonl")
     log = EventLog(path)

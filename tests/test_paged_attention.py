@@ -1,0 +1,185 @@
+"""Paged decode attention kernel vs. pure-jax reference.
+
+The kernel runs in Pallas interpreter mode on the CPU test backend —
+the identical kernel body that compiles on TPU (ops/paged_attention.py,
+docs/SERVING.md "Autoregressive decode"). Properties pinned here:
+
+- the kernel matches masked-softmax attention over each stream's own
+  page walk, for full and partial last pages;
+- **placement invariance**: the same logical stream scattered across
+  scrambled physical pages is BITWISE identical to the contiguous
+  placement — the property that makes host-side page recycling safe;
+- zero-length streams return exactly zero (not NaN);
+- table entries beyond a stream's used pages are ignored (clamped,
+  predicated off), so the allocator never has to sanitize tails;
+- bf16 inputs survive both kernel and reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_tpu.ops.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_reference,
+)
+
+
+def _dense_reference(q, k, v, length):
+    """Straight masked attention over one stream's dense (T, H, D)."""
+    qf = q.astype(np.float32)                      # (H, Nq, D)
+    kf = k[:length].astype(np.float32)             # (t, H, D)
+    vf = v[:length].astype(np.float32)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = np.einsum("hnd,thd->hnt", qf, kf) * scale
+    w = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    w = w / w.sum(axis=-1, keepdims=True)
+    return np.einsum("hnt,thd->hnd", w, vf)
+
+
+def _make_case(rng, *, r=4, h=2, nq=8, d=16, num_pages=32, page_size=8,
+               pps=4, lengths=(0, 3, 8, 29), dtype=np.float32):
+    """Build a pool with each stream's tokens on randomly chosen
+    pages, plus the dense per-stream views the oracle uses."""
+    q = rng.standard_normal((r, h, nq, d)).astype(dtype)
+    k_pages = rng.standard_normal(
+        (num_pages, page_size, h, d)).astype(dtype)
+    v_pages = rng.standard_normal(
+        (num_pages, page_size, h, d)).astype(dtype)
+    perm = rng.permutation(np.arange(1, num_pages))
+    tables = np.zeros((r, pps), np.int32)
+    taken = 0
+    for i in range(r):
+        tables[i] = perm[taken:taken + pps]
+        taken += pps
+    lengths = np.asarray(lengths, np.int32)
+    dense_k = np.stack([
+        k_pages[tables[i]].reshape(pps * page_size, h, d)
+        for i in range(r)])
+    dense_v = np.stack([
+        v_pages[tables[i]].reshape(pps * page_size, h, d)
+        for i in range(r)])
+    return q, k_pages, v_pages, tables, lengths, dense_k, dense_v
+
+
+def test_kernel_matches_dense_oracle_fp32():
+    rng = np.random.default_rng(0)
+    q, kp, vp, tables, lengths, dk, dv = _make_case(rng)
+    out = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths)))
+    for i, t in enumerate(lengths):
+        if t == 0:
+            np.testing.assert_array_equal(out[i], 0.0)
+        else:
+            np.testing.assert_allclose(
+                out[i], _dense_reference(q[i], dk[i], dv[i], int(t)),
+                rtol=2e-5, atol=2e-5)
+
+
+def test_reference_matches_dense_oracle():
+    rng = np.random.default_rng(1)
+    q, kp, vp, tables, lengths, dk, dv = _make_case(rng)
+    out = np.asarray(paged_decode_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths)))
+    for i, t in enumerate(lengths):
+        if t == 0:
+            np.testing.assert_array_equal(out[i], 0.0)
+        else:
+            np.testing.assert_allclose(
+                out[i], _dense_reference(q[i], dk[i], dv[i], int(t)),
+                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+def test_kernel_matches_reference(dtype):
+    rng = np.random.default_rng(2)
+    q, kp, vp, tables, lengths, _, _ = _make_case(
+        rng, lengths=(5, 1, 32, 17),
+        dtype=np.float32)
+    args = [jnp.asarray(a).astype(dtype) for a in (q, kp, vp)]
+    got = paged_decode_attention(
+        *args, jnp.asarray(tables), jnp.asarray(lengths))
+    want = paged_decode_attention_reference(
+        *args, jnp.asarray(tables), jnp.asarray(lengths))
+    assert got.dtype == want.dtype
+    tol = 2e-5 if dtype == np.float32 else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=tol, atol=tol)
+
+
+def test_placement_invariance_bitwise():
+    """Contiguous vs scrambled physical pages: bitwise identical.
+
+    This is the contract host-side page recycling stands on — a
+    stream's numerics depend only on its LOGICAL token order, never on
+    which physical pages the allocator happened to hand out."""
+    rng = np.random.default_rng(3)
+    r, h, nq, d = 3, 2, 8, 16
+    num_pages, page_size, pps = 64, 8, 5
+    lengths = np.asarray([37, 12, 40], np.int32)
+    q = rng.standard_normal((r, h, nq, d)).astype(np.float32)
+    tokens_k = rng.standard_normal(
+        (r, pps * page_size, h, d)).astype(np.float32)
+    tokens_v = rng.standard_normal(
+        (r, pps * page_size, h, d)).astype(np.float32)
+
+    def place(order):
+        kp = np.asarray(
+            rng.standard_normal((num_pages, page_size, h, d)),
+            np.float32)  # junk in unused pages must not matter
+        vp = np.asarray(
+            rng.standard_normal((num_pages, page_size, h, d)),
+            np.float32)
+        tables = np.zeros((r, pps), np.int32)
+        for i in range(r):
+            pages = order[i * pps:(i + 1) * pps]
+            tables[i] = pages
+            for j, p in enumerate(pages):
+                kp[p] = tokens_k[i, j * page_size:(j + 1) * page_size]
+                vp[p] = tokens_v[i, j * page_size:(j + 1) * page_size]
+        return jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(tables)
+
+    contiguous = np.arange(1, 1 + r * pps)
+    scrambled = np.random.default_rng(7).permutation(
+        np.arange(1, num_pages))[:r * pps]
+    outs = []
+    for order in (contiguous, scrambled):
+        kp, vp, tables = place(order)
+        outs.append(np.asarray(paged_decode_attention(
+            jnp.asarray(q), kp, vp, tables, jnp.asarray(lengths))))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_table_tail_entries_ignored():
+    """Entries past ceil(length / page_size) may be arbitrary garbage
+    (even out of range — they are clamped)."""
+    rng = np.random.default_rng(4)
+    q, kp, vp, tables, lengths, _, _ = _make_case(
+        rng, lengths=(9, 3, 16, 1))
+    junk = np.array(tables)
+    for i, t in enumerate(lengths):
+        used = max(1, -(-int(t) // 8))
+        junk[i, used:] = 10_000 + i  # out of range on purpose
+    a = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths))
+    b = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(junk), jnp.asarray(lengths))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_survives_jit():
+    rng = np.random.default_rng(5)
+    q, kp, vp, tables, lengths, _, _ = _make_case(rng)
+    f = jax.jit(paged_decode_attention)
+    got = np.asarray(f(jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                       jnp.asarray(tables), jnp.asarray(lengths)))
+    want = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths)))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

@@ -353,7 +353,8 @@ def test_spmd_modules_lint_clean():
 def test_sharded_targets_registered_and_pinned():
     names = {t.name for t in SHARDED_TARGETS}
     assert len(names) >= 2
-    assert {t.kind for t in SHARDED_TARGETS} == {"train", "serve"}
+    assert {t.kind for t in SHARDED_TARGETS} == {"train", "serve",
+                                                 "decode"}
     # ride the default sweep (check.py --all), but not the fast tier —
     # mesh targets pay an XLA compile the warm-cache contract excludes
     assert names <= {t.name for t in CANONICAL_TARGETS}
@@ -466,3 +467,83 @@ def test_tiny_sharded_target_end_to_end(monkeypatch, tmp_path):
     monkeypatch.setattr(shardcheck, "load_shard_budgets",
                         lambda p=None: {})
     assert not run_graph_checks([target], recompile=False).ok
+
+
+# --- end-to-end: a tiny sharded DECODE step (ISSUE 14) ----------------------
+
+
+def _tiny_decode_spmd_target():
+    import jax.numpy as jnp
+    import numpy as np
+
+    def build():
+        from perceiver_tpu.serving.decode import DecodeGeometry
+        from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+        # vocab 128 divides evenly over the model axis (tp2), streams
+        # divide over data (dp2) — same divisibility rules as the
+        # canonical decode_mlm_spmd target, at compile-cheap shapes
+        task = MaskedLanguageModelTask(
+            vocab_size=128, max_seq_len=32, num_latents=4,
+            num_latent_channels=16, num_encoder_layers=2,
+            num_encoder_self_attention_layers_per_block=1)
+        rng = np.random.default_rng(0)
+        return task, {
+            "geometry": DecodeGeometry(max_streams=4, num_pages=9,
+                                       page_size=4, max_seq_len=32),
+            "tokens": jnp.asarray(rng.integers(3, 128, (4,)),
+                                  jnp.int32),
+            "active": jnp.ones((4,), jnp.bool_),
+            "attn_impl": "reference",
+        }
+
+    return StepTarget(name="tiny_decode_spmd_dp2_tp2", build=build,
+                      kind="decode", mesh=DP2_TP2)
+
+
+def test_tiny_sharded_decode_target_end_to_end(tmp_path):
+    """Lower+compile a tiny dp2×tp2 decode step, pin a manifest from
+    its own measurement, and run the shard passes: clean against its
+    pins, tripping against an emptied or zeroed manifest — the
+    seeded-violation proof for the decode shard pin. The carry stays
+    fully donated under explicit shardings (per-shard buffers alias in
+    place), and the sub-floor KV pools may replicate freely."""
+    from perceiver_tpu.analysis import donation_check
+
+    target = _tiny_decode_spmd_target()
+    lowered = lower_target(target)
+    assert lowered.compiled_text, "mesh target must carry compiled HLO"
+    assert lowered.expected_donated == 6  # k1 v1 kn vn lengths tables
+    assert not donation_check(lowered.text, where=target.name,
+                              expected_donated=lowered.expected_donated)
+    # replicated pools sit below the 1 MiB floor by design
+    assert not replication_check(lowered.text, where=target.name,
+                                 floor_bytes=DEFAULT_FLOOR_BYTES)
+
+    inv = collective_inventory(lowered.compiled_text, target.mesh)
+    assert inv["collectives"], \
+        "GSPMD inserted no collectives — the step stopped being SPMD"
+
+    path = str(tmp_path / "shard_budgets.json")
+    write_shard_budgets({target.name: {
+        "mesh": target.mesh.descriptor,
+        "collectives": inv["collectives"],
+        "ops": inv["ops"],
+        "per_shard": lowered.bytes_accessed / target.mesh.n_devices,
+    }}, path=path, note="test")
+    budgets = load_shard_budgets(path)
+
+    vs, _ = run_shard_passes(lowered, budgets=budgets)
+    assert not vs, vs
+    # seeded failures: missing pin and zeroed budgets both trip
+    vs, _ = run_shard_passes(lowered, budgets={})
+    assert {v.check for v in vs} == {"collective_budget",
+                                    "per_shard_hbm_budget"}
+    zeroed = json.loads(json.dumps(budgets))
+    for axis in zeroed[target.name]["collectives"].values():
+        axis["budget_bytes"] = 0
+    zeroed[target.name]["per_shard"]["budget_bytes"] = 0
+    vs, _ = run_shard_passes(lowered, budgets=zeroed)
+    assert any(v.check == "collective_budget" and "exceeds"
+               in v.message for v in vs)
+    assert any(v.check == "per_shard_hbm_budget" for v in vs)
