@@ -8,6 +8,10 @@ Two halves, one currency (``report.Violation``):
   performance lives or dies on, checked where they are decided.
 * Source lint (``lint``) walks the AST for the bug classes that
   should never reach a lowering in the first place.
+* Racecheck (``racecheck``) gates the *host* side: lock-discipline
+  over declared guarded attributes, the global lock-order graph, and
+  callback-under-lock sites — with its runtime twin (the seeded
+  interleaving harness) in ``perceiver_tpu.utils.concurrency``.
 
 ``scripts/check.py`` is the CLI; ``tests/test_graphcheck.py`` keeps
 every pass honest against seeded violations. See docs/ANALYSIS.md.
@@ -15,6 +19,7 @@ every pass honest against seeded violations. See docs/ANALYSIS.md.
 
 from perceiver_tpu.analysis.report import (  # noqa: F401
     DtypeAllow,
+    RaceAllow,
     ReplicationAllow,
     Report,
     TransferAllow,
@@ -62,4 +67,12 @@ from perceiver_tpu.analysis.lint import (  # noqa: F401
     default_lint_paths,
     lint_paths,
     lint_source,
+)
+from perceiver_tpu.analysis.racecheck import (  # noqa: F401
+    check_callback_under_lock,
+    check_guarded_attrs,
+    check_lock_order_cycles,
+    collect_lock_order_edges,
+    default_race_paths,
+    run_racecheck,
 )

@@ -115,7 +115,30 @@ Rules (names are the ``check`` field of emitted violations):
     supervisor can act on (docs/RESILIENCE.md "Multi-host"). Calls
     with any positional argument pass (``d.get(key)``,
     ``done.wait(5)``); a genuinely-unbounded wait that is safe
-    suppresses per line with a reason.
+    suppresses per line with a reason. The same check name also
+    covers Condition hygiene in ``serving/`` and ``fleet/``: a
+    ``.wait()`` with no timeout on an attribute assigned from
+    ``threading.Condition(...)`` is flagged there too — a missed
+    notify (e.g. a producer dying between append and notify) wedges
+    the waiter forever, so every condition wait must be a
+    predicate loop with a bounded wait.
+
+``blocking-under-lock``
+    Blocking work while a lock is held, in the concurrent host-side
+    packages (``serving/``, ``fleet/``, ``distributed/``): inside a
+    ``with <something named *lock*>:`` frame (or a ``with`` on a
+    ``threading.Condition`` attribute, which acquires its lock), flag
+    ``time.sleep``, ``pickle.dumps/loads/dump/load``,
+    ``subprocess.run/Popen/check_*/call``, socket operations
+    (``send``/``sendall``/``recv*``/``accept``/``connect``), builtin
+    ``open()``, and the fleet framing wrappers ``send_msg`` /
+    ``recv_msg``. Work done under a lock serializes every thread that
+    touches that lock — a slow pickle under the router lock stalls
+    all routing, and socket IO under a lock is the PR-5 breaker
+    deadlock shape one hop away. Move the blocking work outside the
+    critical section (snapshot under the lock, do IO after release),
+    or suppress per line with a reason when holding the lock IS the
+    protocol (e.g. one-in-flight-per-connection RPC framing).
 
 Tracing detection is local and conservative: functions decorated with
 ``jax.jit`` / ``partial(jax.jit, ...)``, functions passed to a
@@ -636,6 +659,151 @@ def _check_distributed_blocking_io(tree: ast.AST,
     return out
 
 
+# serving/+fleet/+distributed/: no blocking work under a held lock
+_LOCKISH_NAME_RE = re.compile(r"lock|mutex", re.IGNORECASE)
+_PICKLE_CALLS = {"dumps", "loads", "dump", "load"}
+_SUBPROCESS_CALLS = {"run", "Popen", "check_output", "check_call",
+                     "call"}
+_SOCKET_BLOCKING_ATTRS = {"sendall", "send", "recv", "recv_into",
+                          "recvfrom", "accept", "connect"}
+_FRAMING_CALLS = {"send_msg", "recv_msg"}
+
+
+def _condition_attrs(tree: ast.AST) -> Set[str]:
+    """Final names assigned from a ``threading.Condition(...)`` call
+    anywhere in the module (``self._not_empty = threading.Condition(
+    self._lock)`` → ``"_not_empty"``). Module-wide on purpose: a
+    subclass method using a base-class Condition still resolves."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        chain = _attr_chain(node.value.func)
+        if not chain or chain[-1] != "Condition":
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute):
+                out.add(tgt.attr)
+            elif isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+def _check_blocking_under_lock(tree: ast.AST,
+                               path: str) -> List[Violation]:
+    """``blocking-under-lock``: see the module docstring. A lock frame
+    is a ``with`` whose context expression's final name matches
+    ``lock``/``mutex`` (case-insensitive) or is a known Condition
+    attribute; nested function bodies reset the held set (they run
+    later, on whatever thread calls them)."""
+    cond_attrs = _condition_attrs(tree)
+    out: List[Violation] = []
+
+    def lockish(expr: ast.AST) -> Optional[str]:
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        final = chain[-1]
+        if _LOCKISH_NAME_RE.search(final) or final in cond_attrs:
+            return ".".join(chain)
+        return None
+
+    def classify(call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                return "open() file IO"
+            if func.id in _FRAMING_CALLS:
+                return f"{func.id}() framed socket IO"
+            return None
+        chain = _attr_chain(func)
+        if not chain or not isinstance(func, ast.Attribute):
+            return None
+        root, final = chain[0], chain[-1]
+        if final in _FRAMING_CALLS:
+            return f"{'.'.join(chain)}() framed socket IO"
+        if root == "time" and final == "sleep":
+            return "time.sleep()"
+        if root == "pickle" and final in _PICKLE_CALLS:
+            return f"pickle.{final}() serialization"
+        if root == "subprocess" and final in _SUBPROCESS_CALLS:
+            return f"subprocess.{final}()"
+        if final in _SOCKET_BLOCKING_ATTRS and len(chain) >= 2:
+            return f"{'.'.join(chain)}() socket IO"
+        return None
+
+    def walk(node: ast.AST, held) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_held = held
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                child_held = ()
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                locks = tuple(
+                    (name, child.lineno) for item in child.items
+                    for name in (lockish(item.context_expr),)
+                    if name is not None)
+                child_held = held + locks
+            elif isinstance(child, ast.Call) and held:
+                what = classify(child)
+                if what is not None:
+                    lock_name, lock_line = held[-1]
+                    out.append(Violation(
+                        check="blocking-under-lock",
+                        where=f"{path}:{child.lineno}",
+                        message=f"{what} while holding {lock_name} "
+                                f"(acquired line {lock_line}) — "
+                                "blocking work under a lock "
+                                "serializes every thread on that "
+                                "lock and is one callback away from "
+                                "the breaker-deadlock shape "
+                                "(docs/RESILIENCE.md); snapshot "
+                                "under the lock and do the blocking "
+                                "work after release, or suppress "
+                                "with 'graphcheck: ignore' and a "
+                                "reason if holding the lock is the "
+                                "protocol"))
+            walk(child, child_held)
+
+    walk(tree, ())
+    return out
+
+
+def _check_condition_waits(tree: ast.AST, path: str) -> List[Violation]:
+    """Condition hygiene (emitted as ``distributed-blocking-io``; see
+    module docstring): ``.wait()`` with no positional argument and no
+    ``timeout=`` on an attribute assigned from
+    ``threading.Condition(...)``."""
+    cond_attrs = _condition_attrs(tree)
+    out: List[Violation] = []
+    if not cond_attrs:
+        return out
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "wait"):
+            continue
+        chain = _attr_chain(node.func)
+        if len(chain) < 2 or chain[-2] not in cond_attrs:
+            continue
+        if node.args or any(kw.arg == "timeout"
+                            for kw in node.keywords):
+            continue
+        cond = ".".join(chain[:-1])
+        out.append(Violation(
+            check="distributed-blocking-io",
+            where=f"{path}:{node.lineno}",
+            message=f"{cond}.wait() with no timeout — a missed "
+                    "notify (producer dying between append and "
+                    "notify) wedges this waiter forever; wait in a "
+                    "predicate loop with a bounded timeout so the "
+                    "thread can re-check shutdown flags "
+                    "(docs/RESILIENCE.md), or suppress with "
+                    "'graphcheck: ignore' and a reason"))
+    return out
+
+
 # metric registration sites: one naming convention for all planes
 _METRIC_KINDS = {"counter", "gauge", "histogram"}
 _METRIC_NAME_RE = re.compile(r"^(serving|training|fleet)_[a-z0-9_]+$")
@@ -747,6 +915,13 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
         violations.extend(_check_router_blocking_io(tree, path))
     if "perceiver_tpu/distributed/" in norm:
         violations.extend(_check_distributed_blocking_io(tree, path))
+    if ("perceiver_tpu/serving/" in norm
+            or "perceiver_tpu/fleet/" in norm
+            or "perceiver_tpu/distributed/" in norm):
+        violations.extend(_check_blocking_under_lock(tree, path))
+    if "perceiver_tpu/serving/" in norm \
+            or "perceiver_tpu/fleet/" in norm:
+        violations.extend(_check_condition_waits(tree, path))
     if "perceiver_tpu/parallel/" in norm \
             or norm.endswith("perceiver_tpu/training/spmd.py"):
         violations.extend(_check_unsharded_pjit(tree, path))
@@ -805,7 +980,7 @@ ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
              "impl-field-validation", "serving-host-sync",
              "uncached-compile", "silent-swallow", "router-blocking-io",
              "distributed-blocking-io", "unsharded-pjit",
-             "metrics-conventions")
+             "metrics-conventions", "blocking-under-lock")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

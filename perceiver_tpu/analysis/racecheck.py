@@ -1,0 +1,732 @@
+"""Racecheck: lock-discipline static analysis for the host-side stack.
+
+Graphcheck gates the *compiled* side; this gates the *host* side — the
+40+ locks/conditions/events that keep the serving, fleet, distributed,
+obs, and cache layers coherent under threads. Three passes, one
+currency (``report.Violation``):
+
+``guarded-attrs``
+    Shared mutable attributes are declared via a class-level
+    ``_GUARDED`` dict literal or the ``utils.concurrency.guarded_by``
+    decorator (key forms: ``"attr"``, dotted ``"a.b"``, any-receiver
+    ``"*.attr"``; values: the guarding lock attribute name, or a tuple
+    of acceptable names). The pass flags every read/write of a
+    declared attribute outside a ``with self.<lock>:`` frame.
+    Conventions the pass understands:
+
+    * ``self.X = threading.Condition(self.Y)`` in ``__init__`` makes
+      ``with self.X:`` count as holding ``Y`` (condition aliasing).
+    * Methods named ``*_locked`` are callee-side lock-held — exempt
+      inside, and every call site ``self.foo_locked()`` must itself
+      sit under a lock frame.
+    * ``__init__``/``__del__`` are exempt (pre-publication /
+      tear-down — no concurrent observer can exist yet/any more).
+    * A class-level ``_GUARDED_BY = "Owner._lock"`` string documents
+      externally-guarded classes (e.g. ``PagePool`` lives entirely
+      under ``DecodeEngine._lock``); it is validated but not enforced
+      here — the owner's registry covers the accesses.
+    * A module-level ``_GUARDED_GLOBALS = {"name": "lock_name"}``
+      declares module-global state guarded by a module-global lock.
+
+    A malformed registry (non-dict ``_GUARDED``, non-string keys, a
+    non-constant ``guarded_by`` argument) is itself a violation — a
+    corrupt registry must fail loudly, never silently stop guarding.
+    Escapes need a ``RaceAllow`` entry with a reason (the established
+    ``ReplicationAllow`` style) or a ``graphcheck: ignore`` comment.
+
+``lock-order``
+    Statically extracts nested-acquisition edges (``with A: … with
+    B:`` ⇒ A→B) across the whole tree, resolves condition aliases to
+    their underlying lock, builds the global lock-order graph, and
+    fails on any cycle — including the length-1 cycle of re-acquiring
+    a non-reentrant lock (``RLock`` attributes are recognised and
+    exempt from self-edges).
+
+``callback-under-lock``
+    Flags calls to callback-shaped callees (``on_*``, ``*_callback``,
+    ``*_cb``, ``*_hook``, bare ``callback``) while a lock frame is
+    open — the exact shape of the PR 5 breaker deadlock, where a
+    user callback re-entered the breaker's own lock. Callbacks must
+    fire after the lock is released (snapshot under lock, call
+    outside), which is how every current call site is written.
+
+``run_racecheck`` walks ``serving/``, ``fleet/``, ``distributed/``,
+``obs/``, and ``cache/`` by default and is wired into
+``scripts/check.py --race`` (riding ``--all``). The runtime half —
+the seeded interleaving harness that *proves* these rules and turns
+real races into deterministic regression tests — lives in
+``perceiver_tpu/utils/concurrency.py``. See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from perceiver_tpu.analysis.report import RaceAllow, Report, Violation
+
+# same per-line escape hatch as the lint half
+SUPPRESS_MARKER = "graphcheck: ignore"
+
+RACECHECK_PACKAGES = ("serving", "fleet", "distributed", "obs", "cache")
+
+_CALLBACK_NAME = re.compile(r"^(on_[a-z0-9_]+|.*_(callback|cb|hook)|callback)$")
+_MODULE_LOCK_NAME = re.compile(r".*lock.*", re.IGNORECASE)
+
+# Per-site escapes for guarded-attrs, in the ReplicationAllow style:
+# every entry carries the reason the access is safe without the lock.
+# Every REAL hit found while annotating the tree was fixed instead
+# (Router health writes, Supervisor poison-path add); the
+# deliberately lock-free single-word swaps (engine._params,
+# replica.version) are *not declared* in _GUARDED rather than
+# allowlisted, with the reasoning at the declaration site. What
+# remains here is static-analysis conservatism, not unlocked state.
+RACE_ALLOWLIST: Tuple[RaceAllow, ...] = (
+    # Router._pick's sort key is a lambda; nested defs are analysed
+    # with no locks held (they may run on another thread later), but
+    # this one only ever executes inside the min()/sorted() calls
+    # sitting under 'with self._lock:' in the same method.
+    RaceAllow(attr="Router.health",
+              reason="_pick sort-key lambda; invoked only under "
+                     "self._lock by min()/sorted() in the same frame"),
+    RaceAllow(attr="Router.inflight",
+              reason="_pick sort-key lambda; invoked only under "
+                     "self._lock by min()/sorted() in the same frame"),
+)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def _attr_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """``self.a.b`` -> ("self", "a", "b"); None if not a pure
+    Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _self_chain(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    chain = _attr_chain(node)
+    if chain and chain[0] == "self" and len(chain) > 1:
+        return chain[1:]
+    return None
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _suppressed_lines(src: str) -> Set[int]:
+    return {i for i, line in enumerate(src.splitlines(), start=1)
+            if SUPPRESS_MARKER in line}
+
+
+# ---------------------------------------------------------------------------
+# per-class registry extraction
+# ---------------------------------------------------------------------------
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        # guarded key -> tuple of acceptable lock attr names
+        self.guarded: Dict[str, Tuple[str, ...]] = {}
+        self.has_registry = False
+        self.guarded_by_external: Optional[str] = None
+        # condition attr -> underlying lock attr (itself if standalone)
+        self.cond_alias: Dict[str, str] = {}
+        self.lock_attrs: Set[str] = set()    # assigned threading.Lock()
+        self.rlock_attrs: Set[str] = set()   # assigned threading.RLock()
+        self.registry_violations: List[Violation] = []
+
+
+def _is_threading_ctor(call: ast.AST, ctor: str) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == ctor:
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr == ctor
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading")
+
+
+def _scan_lock_assignments(cls: ast.ClassDef, info: _ClassInfo) -> None:
+    """Find ``self.X = threading.Lock()/RLock()/Condition(...)`` in the
+    class's methods (normally ``__init__``) to learn which attributes
+    are locks and how conditions alias them."""
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        chain = _self_chain(node.targets[0])
+        if chain is None or len(chain) != 1:
+            continue
+        attr = chain[0]
+        if _is_threading_ctor(node.value, "Lock"):
+            info.lock_attrs.add(attr)
+        elif _is_threading_ctor(node.value, "RLock"):
+            info.rlock_attrs.add(attr)
+        elif _is_threading_ctor(node.value, "Condition"):
+            args = node.value.args
+            if args:
+                target = _self_chain(args[0])
+                info.cond_alias[attr] = (target[0] if target
+                                         and len(target) == 1 else attr)
+            else:
+                info.cond_alias[attr] = attr
+
+
+def _registry_corrupt(info: _ClassInfo, path: str, lineno: int,
+                      detail: str) -> None:
+    info.registry_violations.append(Violation(
+        check="guarded-attrs",
+        where=f"{path}:{lineno}",
+        message=f"corrupt guarded-attrs registry on class "
+                f"{info.name}: {detail} — a registry the checker "
+                "cannot read silently stops guarding, so it fails "
+                "loudly instead",
+    ))
+
+
+def _parse_guarded_value(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    s = _const_str(node)
+    if s:
+        return (s,)
+    if isinstance(node, ast.Tuple) and node.elts:
+        out = []
+        for e in node.elts:
+            es = _const_str(e)
+            if not es:
+                return None
+            out.append(es)
+        return tuple(out)
+    return None
+
+
+def _class_info(cls: ast.ClassDef, path: str) -> _ClassInfo:
+    info = _ClassInfo(cls.name)
+    _scan_lock_assignments(cls, info)
+
+    for deco in cls.decorator_list:
+        if not (isinstance(deco, ast.Call)
+                and ((isinstance(deco.func, ast.Name)
+                      and deco.func.id == "guarded_by")
+                     or (isinstance(deco.func, ast.Attribute)
+                         and deco.func.attr == "guarded_by"))):
+            continue
+        info.has_registry = True
+        names = [_const_str(a) for a in deco.args]
+        if len(names) < 2 or any(not n for n in names):
+            _registry_corrupt(info, path, deco.lineno,
+                              "@guarded_by needs a lock name plus at "
+                              "least one attribute, all string literals")
+            continue
+        for attr in names[1:]:
+            info.guarded[attr] = (names[0],)
+
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if tgt.id == "_GUARDED":
+            info.has_registry = True
+            if not isinstance(stmt.value, ast.Dict):
+                _registry_corrupt(info, path, stmt.lineno,
+                                  "_GUARDED must be a dict literal")
+                continue
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                key = _const_str(k) if k is not None else None
+                locks = _parse_guarded_value(v)
+                if not key or not locks:
+                    _registry_corrupt(
+                        info, path, stmt.lineno,
+                        "_GUARDED keys must be string literals and "
+                        "values a lock-attribute name (or tuple of "
+                        "them)")
+                    continue
+                info.guarded[key] = locks
+        elif tgt.id == "_GUARDED_BY":
+            if not _const_str(stmt.value):
+                _registry_corrupt(info, path, stmt.lineno,
+                                  "_GUARDED_BY must be a string literal "
+                                  'like "Owner._lock"')
+            else:
+                info.guarded_by_external = _const_str(stmt.value)
+    return info
+
+
+def _module_guarded_globals(tree: ast.Module, path: str,
+                            out: List[Violation]) -> Dict[str, str]:
+    reg: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "_GUARDED_GLOBALS"):
+            if not isinstance(stmt.value, ast.Dict):
+                out.append(Violation(
+                    "guarded-attrs", f"{path}:{stmt.lineno}",
+                    "corrupt _GUARDED_GLOBALS registry: must be a dict "
+                    "literal of {global name: lock name} string "
+                    "literals"))
+                continue
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                key = _const_str(k) if k is not None else None
+                lock = _const_str(v)
+                if not key or not lock:
+                    out.append(Violation(
+                        "guarded-attrs", f"{path}:{stmt.lineno}",
+                        "corrupt _GUARDED_GLOBALS registry: keys and "
+                        "values must be string literals"))
+                    continue
+                reg[key] = lock
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# pass 1: guarded-attrs
+# ---------------------------------------------------------------------------
+
+def _with_lock_names(node: ast.With, info: Optional[_ClassInfo]) -> Set[str]:
+    """Lock attribute names a ``with`` statement acquires — resolving
+    condition aliases so holding ``self._work`` (a Condition over
+    ``self._lock``) also counts as holding ``_lock``."""
+    held: Set[str] = set()
+    for item in node.items:
+        chain = _self_chain(item.context_expr)
+        if chain and len(chain) == 1:
+            held.add(chain[0])
+            if info and chain[0] in info.cond_alias:
+                held.add(info.cond_alias[chain[0]])
+    return held
+
+
+def _check_method(method: ast.AST, info: _ClassInfo, path: str,
+                  out: List[Violation]) -> None:
+    exempt_body = method.name in ("__init__", "__del__") \
+        or method.name.endswith("_locked")
+    star_keys = {k[2:]: v for k, v in info.guarded.items()
+                 if k.startswith("*.")}
+    plain_keys = {k: v for k, v in info.guarded.items()
+                  if not k.startswith("*.")}
+    seen: Set[Tuple[int, str]] = set()
+
+    def flag(lineno: int, key: str, locks: Tuple[str, ...]) -> None:
+        if (lineno, key) in seen:
+            return
+        seen.add((lineno, key))
+        want = locks[0] if len(locks) == 1 else f"one of {locks}"
+        out.append(Violation(
+            "guarded-attrs", f"{path}:{lineno}",
+            f"{info.name}.{method.name} touches guarded attribute "
+            f"'{key}' without holding '{want}' — wrap the access in "
+            f"'with self.{locks[0]}:' (or a *_locked helper called "
+            "under the lock), or add a RaceAllow with a reason",
+        ))
+
+    def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, ast.With):
+            inner = frozenset(held | _with_lock_names(node, info))
+            for item in node.items:
+                visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, held)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested def/lambda *runs* later, possibly on another
+            # thread with the lock long released — analyse its body
+            # with no locks held (conservative)
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                visit(child, frozenset())
+            return
+        if isinstance(node, ast.Attribute):
+            if not exempt_body:
+                chain = _self_chain(node)
+                if chain:
+                    key = ".".join(chain)
+                    if key in plain_keys \
+                            and not (set(plain_keys[key]) & held):
+                        flag(node.lineno, key, plain_keys[key])
+                if node.attr in star_keys \
+                        and not (set(star_keys[node.attr]) & held):
+                    flag(node.lineno, node.attr, star_keys[node.attr])
+        if isinstance(node, ast.Call):
+            chain = _self_chain(node.func)
+            if (chain and len(chain) == 1
+                    and chain[0].endswith("_locked")
+                    and not held and not exempt_body):
+                out.append(Violation(
+                    "guarded-attrs", f"{path}:{node.lineno}",
+                    f"{info.name}.{method.name} calls "
+                    f"self.{chain[0]}() outside any lock frame — "
+                    "*_locked methods are callee-side lock-held by "
+                    "convention and must only be called with the "
+                    "lock already taken",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in method.body:
+        visit(stmt, frozenset())
+
+
+def _check_globals(tree: ast.Module, registry: Dict[str, str],
+                   path: str, out: List[Violation]) -> None:
+    if not registry:
+        return
+
+    def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, ast.With):
+            acquired = {item.context_expr.id for item in node.items
+                        if isinstance(item.context_expr, ast.Name)}
+            inner = frozenset(held | acquired)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, ast.Name) and node.id in registry \
+                and registry[node.id] not in held:
+            out.append(Violation(
+                "guarded-attrs", f"{path}:{node.lineno}",
+                f"module global '{node.id}' is declared guarded by "
+                f"'{registry[node.id]}' (_GUARDED_GLOBALS) but is "
+                f"accessed without holding it — wrap in "
+                f"'with {registry[node.id]}:'",
+            ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for inner in stmt.body:
+                visit(inner, frozenset())
+
+
+def check_guarded_attrs(tree: ast.Module, path: str) -> List[Violation]:
+    """The guarded-attrs pass over one parsed module (allowlist and
+    suppression-comment filtering happen in :func:`run_racecheck`)."""
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = _class_info(node, path)
+        out.extend(info.registry_violations)
+        if not info.guarded:
+            continue
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _check_method(item, info, path, out)
+    _check_globals(tree, _module_guarded_globals(tree, path, out),
+                   path, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 2: lock-order
+# ---------------------------------------------------------------------------
+
+def _lockish_identity(expr: ast.AST, modbase: str, clsname: Optional[str],
+                      info: Optional[_ClassInfo]) -> Optional[str]:
+    """Qualified identity of a lock-acquiring ``with`` context, or None
+    if the expression is not lock-like. ``self.X`` -> module.Class.X
+    (condition aliases resolved to their underlying lock); module-level
+    ``NAME`` -> module.NAME."""
+    chain = _self_chain(expr)
+    if chain and len(chain) == 1:
+        attr = chain[0]
+        lockish = ("lock" in attr.lower()
+                   or (info is not None
+                       and (attr in info.cond_alias
+                            or attr in info.lock_attrs
+                            or attr in info.rlock_attrs)))
+        if not lockish:
+            return None
+        if info is not None and attr in info.cond_alias:
+            attr = info.cond_alias[attr]
+        return f"{modbase}.{clsname or '?'}.{attr}"
+    if isinstance(expr, ast.Name) and _MODULE_LOCK_NAME.match(expr.id):
+        return f"{modbase}.{expr.id}"
+    return None
+
+
+def collect_lock_order_edges(tree: ast.Module, path: str):
+    """All nested-acquisition edges ``(held, acquired, site)`` plus
+    same-lock re-entry violations for non-reentrant locks."""
+    modbase = os.path.basename(path)
+    if modbase.endswith(".py"):
+        modbase = modbase[:-3]
+    edges: List[Tuple[str, str, str]] = []
+    self_violations: List[Violation] = []
+
+    def walk_fn(fn: ast.AST, clsname: Optional[str],
+                info: Optional[_ClassInfo]) -> None:
+
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, ast.With):
+                acquired = []
+                for item in node.items:
+                    ident = _lockish_identity(item.context_expr, modbase,
+                                              clsname, info)
+                    if ident is None:
+                        continue
+                    acquired.append(ident)
+                    reentrant = False
+                    chain = _self_chain(item.context_expr)
+                    if chain and info and chain[0] in info.rlock_attrs:
+                        reentrant = True
+                    if ident in held and not reentrant:
+                        self_violations.append(Violation(
+                            "lock-order", f"{path}:{node.lineno}",
+                            f"'{ident}' is acquired while already "
+                            "held (non-reentrant lock nested in its "
+                            "own frame) — this self-deadlocks on "
+                            "first execution",
+                        ))
+                    for h in held:
+                        if h != ident:
+                            edges.append((h, ident,
+                                          f"{path}:{node.lineno}"))
+                for child in node.body:
+                    visit(child, held + tuple(a for a in acquired
+                                              if a not in held))
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                for child in body:
+                    visit(child, ())
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, ())
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(node, None, None)
+        elif isinstance(node, ast.ClassDef):
+            info = _class_info(node, path)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    walk_fn(item, node.name, info)
+    return edges, self_violations
+
+
+def check_lock_order_cycles(
+        edges: Sequence[Tuple[str, str, str]]) -> List[Violation]:
+    """Build the global lock-order digraph and fail on cycles."""
+    graph: Dict[str, Dict[str, str]] = {}
+    for a, b, site in edges:
+        graph.setdefault(a, {}).setdefault(b, site)
+        graph.setdefault(b, {})
+
+    out: List[Violation] = []
+    color: Dict[str, int] = {}     # 0 unvisited / 1 on stack / 2 done
+    stack: List[str] = []
+    reported: Set[FrozenSet[str]] = set()
+
+    def dfs(n: str) -> None:
+        color[n] = 1
+        stack.append(n)
+        for m, site in sorted(graph[n].items()):
+            if color.get(m, 0) == 1:
+                cycle = stack[stack.index(m):] + [m]
+                key = frozenset(cycle)
+                if key not in reported:
+                    reported.add(key)
+                    sites = [graph[cycle[i]][cycle[i + 1]]
+                             for i in range(len(cycle) - 1)]
+                    out.append(Violation(
+                        "lock-order", site,
+                        "lock-order cycle: "
+                        + " -> ".join(cycle)
+                        + f" (acquisition sites: {', '.join(sites)}) — "
+                        "two threads taking these locks in opposite "
+                        "orders deadlock; pick one global order and "
+                        "restructure the inner acquisition",
+                    ))
+            elif color.get(m, 0) == 0:
+                dfs(m)
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(graph):
+        if color.get(n, 0) == 0:
+            dfs(n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pass 3: callback-under-lock
+# ---------------------------------------------------------------------------
+
+def check_callback_under_lock(tree: ast.Module,
+                              path: str) -> List[Violation]:
+    modbase = os.path.basename(path)
+    if modbase.endswith(".py"):
+        modbase = modbase[:-3]
+    out: List[Violation] = []
+
+    def walk_fn(fn: ast.AST, clsname: Optional[str],
+                info: Optional[_ClassInfo]) -> None:
+
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, ast.With):
+                acquired = tuple(
+                    ident for item in node.items
+                    if (ident := _lockish_identity(
+                        item.context_expr, modbase, clsname, info))
+                    is not None)
+                for child in node.body:
+                    visit(child, held + acquired)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                for child in body:
+                    visit(child, ())
+                return
+            if isinstance(node, ast.Call) and held:
+                name = None
+                if isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    name = node.func.id
+                if name and _CALLBACK_NAME.match(name):
+                    out.append(Violation(
+                        "callback-under-lock", f"{path}:{node.lineno}",
+                        f"callback-shaped call '{name}(...)' while "
+                        f"holding {held[-1]} — a callback that "
+                        "re-enters this component (the PR 5 breaker "
+                        "shape) deadlocks; snapshot under the lock, "
+                        "release it, then fire the callback",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, ())
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(node, None, None)
+        elif isinstance(node, ast.ClassDef):
+            info = _class_info(node, path)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    walk_fn(item, node.name, info)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def default_race_paths(repo_root: str) -> List[str]:
+    pkg = os.path.join(repo_root, "perceiver_tpu")
+    return [os.path.join(pkg, p) for p in RACECHECK_PACKAGES
+            if os.path.isdir(os.path.join(pkg, p))]
+
+
+def _expand(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(root, n)
+                             for n in sorted(names) if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    return files
+
+
+def _apply_allowlist(violations: List[Violation],
+                     allowlist: Sequence[RaceAllow]) -> List[Violation]:
+    budgets = {id(a): a.max_count for a in allowlist}
+    kept: List[Violation] = []
+    for v in violations:
+        if v.check != "guarded-attrs":
+            kept.append(v)
+            continue
+        m = re.search(r"(\S+)\.\S+ touches guarded attribute '([^']+)'",
+                      v.message)
+        qual = f"{m.group(1).rsplit('.', 1)[0]}.{m.group(2)}" if m else ""
+        hit = None
+        for a in allowlist:
+            if budgets[id(a)] > 0 and a.attr == qual:
+                hit = a
+                break
+        if hit is not None:
+            budgets[id(hit)] -= 1
+        else:
+            kept.append(v)
+    return kept
+
+
+def run_racecheck(paths: Optional[Sequence[str]] = None,
+                  repo_root: Optional[str] = None,
+                  allowlist: Sequence[RaceAllow] = RACE_ALLOWLIST,
+                  ) -> Report:
+    """Run all three racecheck passes over ``paths`` (defaulting to the
+    concurrent host-side packages) and return a merged Report."""
+    if paths is None:
+        if repo_root is None:
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+        paths = default_race_paths(repo_root)
+    report = Report()
+    for check in ("guarded-attrs", "lock-order", "callback-under-lock"):
+        report.ran(check)
+
+    all_edges: List[Tuple[str, str, str]] = []
+    violations: List[Violation] = []
+    suppressed: Dict[str, Set[int]] = {}
+    for path in _expand(paths):
+        with open(path, "r") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            violations.append(Violation(
+                "guarded-attrs", f"{path}:{e.lineno or 0}",
+                f"could not parse module: {e.msg}"))
+            continue
+        suppressed[path] = _suppressed_lines(src)
+        violations.extend(check_guarded_attrs(tree, path))
+        violations.extend(check_callback_under_lock(tree, path))
+        edges, self_viol = collect_lock_order_edges(tree, path)
+        all_edges.extend(edges)
+        violations.extend(self_viol)
+    violations.extend(check_lock_order_cycles(all_edges))
+
+    violations = _apply_allowlist(violations, allowlist)
+    for v in violations:
+        where_path, _, lineno = v.where.rpartition(":")
+        try:
+            if int(lineno) in suppressed.get(where_path, ()):
+                continue
+        except ValueError:
+            pass
+        report.add(v)
+    return report

@@ -104,6 +104,21 @@ class TransferAllow:
 
 
 @dataclasses.dataclass(frozen=True)
+class RaceAllow:
+    """One ``guarded-attrs`` (racecheck) allowlist entry: permits up to
+    ``max_count`` accesses of a guarded attribute outside its declared
+    lock, identified as ``"ClassName.attr"`` (dotted and ``*.attr``
+    keys use the key spelling, e.g. ``"Router.inflight"`` for the
+    ``"*.inflight"`` declaration). Every entry must carry a human
+    reason — the allowlist IS the audit trail, same contract as the
+    dtype/transfer/replication allowlists."""
+
+    attr: str                       # "ClassName.attr"
+    reason: str
+    max_count: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class ReplicationAllow:
     """One ``replication_check`` allowlist entry: permits up to
     ``max_count`` tensors of the given type string (``"8192x64xf32"``)

@@ -168,6 +168,21 @@ class ExecutableCache:
     """One cache directory of serialized executables + lowering
     records, shareable between concurrent processes."""
 
+    # lock discipline (gated by check.py --race): the stats struct's
+    # fields are bumped from whichever thread compiles/loads (dotted
+    # keys — the struct itself is assigned once in __init__ and never
+    # rebound). On-disk state needs no lock here: every write is an
+    # atomic tmp+rename, which is the cross-PROCESS discipline.
+    _GUARDED = {
+        "stats.hits": "_lock",
+        "stats.misses": "_lock",
+        "stats.corrupt": "_lock",
+        "stats.evicted": "_lock",
+        "stats.stores": "_lock",
+        "stats.bytes_read": "_lock",
+        "stats.bytes_written": "_lock",
+    }
+
     def __init__(self, path: str, *,
                  max_bytes: int = _DEFAULT_MAX_BYTES,
                  native: bool = True):

@@ -82,6 +82,18 @@ def build_task(spec: dict):
 class ReplicaServer:
     """Engine + RPC plumbing + the cutover guard for one replica."""
 
+    # lock discipline (gated by check.py --race): the cutover guard
+    # state, written by _update/_commit/_abort and read per dispatch;
+    # _idle is a Condition over _lock. Deliberately NOT declared:
+    # self.version — it is swapped with a single str assignment only
+    # while the replica is quiesced (_swapping set, _inflight drained
+    # to 0), so readers race only against an atomic rebind.
+    _GUARDED = {
+        "_inflight": "_lock",
+        "_swapping": "_lock",
+        "_staged": "_lock",
+    }
+
     def __init__(self, spec: dict):
         self.spec = spec
         self._lock = threading.Lock()

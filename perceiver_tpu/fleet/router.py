@@ -98,6 +98,18 @@ class Router:
     RpcReplicaHandle`); tests pass fakes.
     """
 
+    # lock discipline (gated by check.py --race): the replica map and
+    # every mutable _ReplicaState field the router itself writes
+    # (any-receiver keys — the states are picked out of the map and
+    # mutated through locals). rid/handle/breaker/accepts_trace are
+    # write-once at add(); the breaker has its own internal lock.
+    _GUARDED = {
+        "_replicas": "_lock",
+        "*.inflight": "_lock",
+        "*.draining": "_lock",
+        "*.health": "_lock",
+    }
+
     def __init__(self, *, max_attempts: int = 4,
                  retry_backoff_s: float = 0.02,
                  breaker_failure_threshold: int = 3,
@@ -328,7 +340,11 @@ class Router:
                         c.absorb(spans, replica=state.rid)
                 if ctxs:
                     reply.setdefault("trace_id", ctxs[0].trace_id)
-                state.health = reply.get("health", state.health)
+                # under the lock: _pick reads health on another thread
+                # concurrently, and a torn read there routes traffic to
+                # a replica the reply just reported UNAVAILABLE
+                with self._lock:
+                    state.health = reply.get("health", state.health)
             self._m_requests.labels(outcome="ok").inc()
             return reply
         self._m_requests.labels(outcome="unavailable").inc()
@@ -370,7 +386,8 @@ class Router:
                     continue
                 except Exception:  # pragma: no cover - handle bug
                     continue  # graphcheck: ignore — prober must not die
-                state.health = status.get("health", state.health)
+                with self._lock:
+                    state.health = status.get("health", state.health)
 
     def close(self) -> None:
         self._closed.set()

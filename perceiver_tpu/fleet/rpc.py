@@ -166,8 +166,11 @@ class RpcClient:
             if self._sock is None:
                 self._sock = self._connect()
             try:
-                send_msg(self._sock, {"op": op, **kwargs}, deadline)
-                reply = recv_msg(self._sock, deadline)
+                # Holding _lock across the framed round-trip IS the
+                # protocol: one in-flight request per connection, and
+                # both ops are deadline-bounded above.
+                send_msg(self._sock, {"op": op, **kwargs}, deadline)  # graphcheck: ignore
+                reply = recv_msg(self._sock, deadline)  # graphcheck: ignore
             except RpcError:
                 self._close_locked()
                 raise

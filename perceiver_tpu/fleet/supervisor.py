@@ -169,6 +169,17 @@ class Supervisor:
     membership: a live handle on (re)spawn, ``None`` on death/retire.
     """
 
+    # lock discipline (gated by check.py --race): membership and the
+    # restart/poison budgets are written by the monitor thread and
+    # read by callers; on_change callbacks always fire OUTSIDE the
+    # lock (the callback-under-lock pass keeps it that way).
+    _GUARDED = {
+        "_procs": "_lock",
+        "_restarts": "_lock",
+        "_poisoned": "_lock",
+        "_next_id": "_lock",
+    }
+
     def __init__(self, spec: dict, workdir: str, *,
                  max_restarts: int = 3, backoff_s: float = 0.2,
                  poll_interval_s: float = 0.2,
@@ -295,7 +306,10 @@ class Supervisor:
         try:
             replacement = self._spawn_proc(rid)
         except ReplicaSpawnError:
-            self._poisoned.add(rid)
+            # under the lock: poisoned() sorts this set concurrently,
+            # and a set mutating mid-sort raises on the reader
+            with self._lock:
+                self._poisoned.add(rid)
             return
         with self._lock:
             self._procs[rid] = replacement

@@ -97,6 +97,12 @@ class EventLog:
     """In-memory ring of typed events, optionally mirrored to a JSONL
     file with size-based rotation (``path`` -> ``path.1`` -> ...)."""
 
+    # the ring is appended from every instrumented thread; the JSONL
+    # mirror (_write/_rotate) also runs under _lock so rotation never
+    # interleaves with an append — obs/ is off the dispatch hot path,
+    # which is why file IO under this lock is acceptable here
+    _GUARDED = {"_ring": "_lock"}
+
     def __init__(self, path: Optional[str] = None, *,
                  max_bytes: int = 1 << 20, max_backups: int = 3,
                  ring: int = 1024) -> None:
@@ -168,6 +174,10 @@ ENV_VAR = "PERCEIVER_EVENT_LOG"
 
 _default_lock = threading.Lock()
 _default: Optional[EventLog] = None
+
+# module-global lock discipline (gated by check.py --race): the lazy
+# default-log singleton is read/written only under _default_lock
+_GUARDED_GLOBALS = {"_default": "_default_lock"}
 
 
 def default_log() -> EventLog:

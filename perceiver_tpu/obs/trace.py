@@ -103,6 +103,10 @@ class SpanCollector:
 class TraceBuffer:
     """Bounded in-memory ring of traces (LRU-evicting, thread-safe)."""
 
+    # spans arrive from every serving thread; the LRU OrderedDict and
+    # the overflow counter move together under one lock
+    _GUARDED = {"_traces": "_lock", "dropped_spans": "_lock"}
+
     def __init__(self, max_traces: int = 256,
                  max_spans_per_trace: int = 128) -> None:
         self.max_traces = int(max_traces)

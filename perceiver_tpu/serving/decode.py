@@ -139,6 +139,12 @@ class PagePool:
     loud instead of silently corrupting a neighbour stream's cache.
     """
 
+    # externally guarded: a PagePool has no lock of its own — every
+    # alloc/free happens inside the owning engine's critical sections
+    # (racecheck validates the declaration; the owner's _GUARDED
+    # registry covers the call sites)
+    _GUARDED_BY = "DecodeEngine._lock"
+
     def __init__(self, num_pages: int, page_size: int):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 reserved)")
@@ -468,6 +474,24 @@ class DecodeEngine:
     whenever work exists; tests pass ``auto_step=False`` and drive
     :meth:`step` / :meth:`run_until_idle` deterministically.
     """
+
+    # lock discipline (gated by check.py --race): every mutable piece
+    # of scheduler state below is touched only under self._lock —
+    # self._work is a Condition over the same lock, so 'with
+    # self._work:' frames count. params/pool ride along because the
+    # step loop swaps/mutates them while streams are in flight.
+    _GUARDED = {
+        "_streams": "_lock",
+        "_tables": "_lock",
+        "_lengths": "_lock",
+        "_dirty": "_lock",
+        "_seq": "_lock",
+        "_closed": "_lock",
+        "_failed": "_lock",
+        "_carry": "_lock",
+        "params": "_lock",
+        "pool": "_lock",
+    }
 
     def __init__(self, task, params=None, *,
                  geometry: DecodeGeometry,

@@ -131,6 +131,16 @@ class PackedServeResult:
 class ServingEngine:
     """Checkpoint-loaded, AOT-compiled, bucketed forward executor."""
 
+    # lock discipline (gated by check.py --race). Deliberately NOT
+    # declared: _params/_params_src — update_params swaps each with a
+    # single reference assignment (atomic under the GIL, pinned by the
+    # torn-pytree stress test), so readers never see a torn tree and
+    # the hot path takes no lock.
+    _GUARDED = {
+        "_exe": "_exe_lock",
+        "_breakers": "_breaker_lock",
+    }
+
     def __init__(self, task=None, params=None, *,
                  graph: Optional[ServeGraph] = None,
                  checkpoint: Optional[str] = None,

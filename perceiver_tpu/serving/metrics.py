@@ -21,6 +21,8 @@ import math
 import threading
 from typing import Dict, Iterable, Optional, Tuple
 
+from perceiver_tpu.utils.concurrency import guarded_by
+
 # seconds; spans 100 µs → 10 s, roughly log-spaced (serving latencies
 # on CPU tests sit in the ms range, on chips in the 100 µs range)
 DEFAULT_LATENCY_BUCKETS = (
@@ -74,6 +76,7 @@ def _fmt_value(v: float) -> str:
     return out[:-2] if out.endswith(".0") else out
 
 
+@guarded_by("_lock", "_values")
 class Counter:
     """Monotonic counter family; ``labels(...)`` returns a child whose
     increments are tracked per label set."""
@@ -134,6 +137,7 @@ class _CounterChild:
         self._parent._inc(self._key, amount)
 
 
+@guarded_by("_lock", "_value", "_children")
 class Gauge:
     """Set-to-current-value metric (queue depth, bucket count).
 
@@ -217,6 +221,8 @@ class _GaugeChild:
         self._parent._remove_child(self._key)
 
 
+@guarded_by("_lock", "_counts", "_sum", "_count", "_reservoir",
+            "_reservoir_n")
 class Histogram:
     """Cumulative-bucket histogram + bounded reservoir for quantiles."""
 
@@ -282,6 +288,7 @@ class Histogram:
         yield f"{self.name}_count {total}"
 
 
+@guarded_by("_lock", "_metrics")
 class MetricsRegistry:
     """Namespace of metrics with Prometheus text exposition.
 

@@ -35,6 +35,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import warnings
 from typing import Any, Dict, Optional
 
@@ -341,6 +342,12 @@ class ParamsVersionStore:
 
     def __init__(self, directory: str):
         self.directory = _abs(directory)
+        # the CURRENT pointer state lives on disk, so there is no
+        # _GUARDED attr to declare — but two threads of ONE process
+        # share the pid-suffixed temp name, so the write-then-replace
+        # in set_current needs in-process serialization (cross-process
+        # writers already each get their own pid)
+        self._lock = threading.Lock()
         os.makedirs(self.directory, exist_ok=True)
 
     # -- publishing -------------------------------------------------------
@@ -364,14 +371,19 @@ class ParamsVersionStore:
 
     def set_current(self, version: str) -> None:
         """Atomically repoint CURRENT (tempfile + ``os.replace`` — a
-        crash leaves the old pointer, never a torn one)."""
+        crash leaves the old pointer, never a torn one). Serialized
+        in-process: concurrent callers share the pid-suffixed temp
+        name, and an unserialized pair can os.replace the temp file
+        out from under a sibling mid-write."""
         if version not in self.versions():
             raise FileNotFoundError(f"unknown version {version!r}")
         tmp = os.path.join(self.directory,
                            f".{self.CURRENT_NAME}.tmp.{os.getpid()}")
-        with open(tmp, "w") as f:
-            f.write(version + "\n")
-        os.replace(tmp, os.path.join(self.directory, self.CURRENT_NAME))
+        with self._lock:
+            with open(tmp, "w") as f:
+                f.write(version + "\n")
+            os.replace(tmp,
+                       os.path.join(self.directory, self.CURRENT_NAME))
 
     # -- reading ----------------------------------------------------------
 

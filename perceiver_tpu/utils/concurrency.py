@@ -1,0 +1,368 @@
+"""Concurrency substrate: the ``guarded_by`` registry + a deterministic
+interleaving harness.
+
+Two halves, one contract (see docs/ANALYSIS.md, "Racecheck"):
+
+* **Declaration** — classes declare which lock guards which shared
+  mutable attribute, either with a class-level ``_GUARDED`` dict
+  literal (readable by both the runtime and the AST pass in
+  ``analysis/racecheck.py``) or with the :func:`guarded_by` class
+  decorator. The static pass then *gates* the declaration: any
+  read/write of a declared attribute outside a ``with self._lock:``
+  frame fails ``check.py --race``.
+
+* **Proof** — :class:`InterleaveScheduler` + :class:`InstrumentedLock`
+  + :class:`SchedPoint` let a test drive two (or more) threads through
+  a *seeded* yield schedule, so every racecheck rule is proven to fail
+  on a seeded violation and every real race gets a bitwise-reproducible
+  regression test instead of a flaky stress loop. :func:`guarded`
+  wraps a piece of shared state in a proxy that raises
+  :class:`UnguardedAccessError` the instant any thread touches it
+  without holding the instrumented lock — which is what turns
+  "this interleaving is racy" into a deterministic assertion.
+
+This module is dependency-free (stdlib ``threading`` only) so the
+serving/fleet hot paths can annotate themselves without importing any
+analysis machinery.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+__all__ = [
+    "guarded_by",
+    "InterleaveScheduler",
+    "InstrumentedLock",
+    "SchedPoint",
+    "UnguardedAccessError",
+    "guarded",
+]
+
+
+# ---------------------------------------------------------------------------
+# guarded_by registry
+# ---------------------------------------------------------------------------
+
+def guarded_by(lock_name: str, *attrs: str) -> Callable[[type], type]:
+    """Class decorator declaring that ``attrs`` are guarded by
+    ``self.<lock_name>``.
+
+    Equivalent to (and merged with) a class-level ``_GUARDED`` dict::
+
+        @guarded_by("_lock", "_queue", "_closed")
+        class MicroBatcher: ...
+
+        class MicroBatcher:
+            _GUARDED = {"_queue": "_lock", "_closed": "_lock"}
+
+    Key forms understood by the static pass (and therefore by this
+    registry):
+
+    * ``"attr"``   — ``self.attr`` in the class's methods.
+    * ``"a.b"``    — the dotted chain ``self.a.b`` (e.g. a stats
+      struct whose *fields* are guarded).
+    * ``"*.attr"`` — ``<anything>.attr`` in the class's methods (e.g.
+      per-replica record fields mutated by their owning manager).
+
+    Raises ``TypeError`` on malformed arguments — a corrupt registry
+    must fail loudly, never silently stop guarding (the AST pass
+    enforces the same for hand-written ``_GUARDED`` literals).
+    """
+    if not isinstance(lock_name, str) or not lock_name:
+        raise TypeError("guarded_by: lock name must be a non-empty str, "
+                        f"got {lock_name!r}")
+    if not attrs:
+        raise TypeError("guarded_by: declare at least one attribute")
+    for a in attrs:
+        if not isinstance(a, str) or not a:
+            raise TypeError("guarded_by: attribute names must be "
+                            f"non-empty str, got {a!r}")
+
+    def deco(cls: type) -> type:
+        merged: Dict[str, Union[str, Tuple[str, ...]]] = dict(
+            getattr(cls, "_GUARDED", None) or {})
+        for a in attrs:
+            merged[a] = lock_name
+        cls._GUARDED = merged
+        return cls
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# deterministic interleaving harness
+# ---------------------------------------------------------------------------
+
+class _Task:
+    __slots__ = ("name", "fn", "go", "parked", "done", "exc", "thread")
+
+    def __init__(self, name: str, fn: Callable[[], None]):
+        self.name = name
+        self.fn = fn
+        self.go = threading.Event()      # controller -> thread: run
+        self.parked = threading.Event()  # thread -> controller: yielded
+        self.done = threading.Event()
+        self.exc: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
+
+
+class InterleaveScheduler:
+    """Seeded cooperative scheduler: exactly one managed thread runs at
+    a time, and *which* one runs next is drawn from
+    ``random.Random(seed)`` — so a given seed replays the exact same
+    interleaving forever.
+
+    Managed threads hand control back at :meth:`point` calls (inserted
+    by tests, by :class:`SchedPoint` shims monkeypatched into code
+    under test, or implicitly by :class:`InstrumentedLock` while
+    spinning on a contended lock). Threads the scheduler does not know
+    about pass through ``point()`` unscheduled, so instrumented code
+    keeps working outside the harness.
+
+    Usage::
+
+        sched = InterleaveScheduler(seed=1234)
+        sched.spawn(writer, name="writer")
+        sched.spawn(reader, name="reader")
+        sched.run()          # drives both to completion, re-raising
+                             # the first managed-thread exception
+        sched.trace          # the (thread, label) yield sequence
+    """
+
+    def __init__(self, seed: int = 0, block_timeout: float = 1.0):
+        self._rng = random.Random(seed)
+        self.seed = seed
+        # if a managed thread blocks outside a sched point (e.g. on a
+        # real OS primitive), the controller stops waiting for it after
+        # block_timeout and schedules someone else instead of hanging
+        self.block_timeout = block_timeout
+        self._tasks: List[_Task] = []
+        self._tls = threading.local()
+        self.trace: List[Tuple[str, str]] = []
+        self._trace_lock = threading.Lock()
+
+    def spawn(self, fn: Callable[[], None],
+              name: Optional[str] = None) -> None:
+        """Register ``fn`` to run on a managed thread. The thread is
+        created immediately but does not run until :meth:`run`."""
+        task = _Task(name or f"t{len(self._tasks)}", fn)
+        task.thread = threading.Thread(
+            target=self._body, args=(task,), name=task.name, daemon=True)
+        self._tasks.append(task)
+        task.thread.start()
+
+    def _body(self, task: _Task) -> None:
+        self._tls.task = task
+        task.go.wait()
+        try:
+            task.fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised by run()
+            task.exc = e
+        finally:
+            task.done.set()
+            task.parked.set()  # wake the controller
+
+    def point(self, label: str = "") -> None:
+        """Yield point. On a managed thread: record the label, park,
+        and wait for the controller to reschedule this thread. On any
+        other thread: no-op."""
+        task = getattr(self._tls, "task", None)
+        if task is None:
+            return
+        with self._trace_lock:
+            self.trace.append((task.name, label))
+        task.go.clear()
+        task.parked.set()
+        task.go.wait()
+
+    def run(self, timeout: float = 30.0) -> None:
+        """Drive every spawned thread to completion under the seeded
+        schedule; re-raise the first managed-thread exception (in
+        spawn order)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            live = [t for t in self._tasks if not t.done.is_set()]
+            if not live:
+                break
+            if time.monotonic() > deadline:
+                states = {t.name: ("parked" if t.parked.is_set()
+                                   else "running") for t in live}
+                raise RuntimeError(
+                    f"InterleaveScheduler.run timed out; live={states} "
+                    f"trace tail={self.trace[-8:]}")
+            task = self._rng.choice(live)
+            task.parked.clear()
+            task.go.set()
+            # thread runs until its next point() or completion; the
+            # timeout is the external-block fallback, not the schedule
+            task.parked.wait(self.block_timeout)
+        for t in self._tasks:
+            t.thread.join(timeout=self.block_timeout)
+        for t in self._tasks:
+            if t.exc is not None:
+                raise t.exc
+
+
+class SchedPoint:
+    """A named, callable yield point bound to a scheduler — handy for
+    monkeypatching into code under test::
+
+        hook = SchedPoint(sched, "after-snapshot")
+        ...
+        hook()   # yields iff called from a managed thread
+    """
+
+    def __init__(self, scheduler: InterleaveScheduler, label: str):
+        self._scheduler = scheduler
+        self.label = label
+
+    def __call__(self) -> None:
+        self._scheduler.point(self.label)
+
+
+class InstrumentedLock:
+    """Drop-in ``threading.Lock`` replacement that (a) tracks which
+    thread holds it and (b) cooperates with an
+    :class:`InterleaveScheduler` — a contended blocking ``acquire``
+    spins through sched points instead of blocking in the OS, so the
+    scheduler always keeps control of the interleaving.
+
+    Tests typically swap an object's real lock for one of these
+    (``obj._lock = InstrumentedLock(sched)``) and wrap the guarded
+    state with :func:`guarded` to assert the discipline dynamically.
+    """
+
+    def __init__(self, scheduler: Optional[InterleaveScheduler] = None,
+                 name: str = "lock"):
+        self._inner = threading.Lock()
+        self._scheduler = scheduler
+        self.name = name
+        self._owner: Optional[int] = None
+        self.acquisitions = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not blocking:
+            got = self._inner.acquire(False)
+            if got:
+                self._owner = threading.get_ident()
+                self.acquisitions += 1
+            return got
+        deadline = (None if timeout is None or timeout < 0
+                    else time.monotonic() + timeout)
+        if self._scheduler is not None:
+            # give the scheduler a crack at interleaving right before
+            # the acquisition — this is where races become visible
+            self._scheduler.point(f"acquire:{self.name}")
+        while True:
+            if self._inner.acquire(False):
+                self._owner = threading.get_ident()
+                self.acquisitions += 1
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            if self._scheduler is not None:
+                self._scheduler.point(f"lock-wait:{self.name}")
+            else:
+                time.sleep(0.0005)
+
+    def release(self) -> None:
+        self._owner = None
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def held_by_current_thread(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    # threading.Condition(lock) probes this when present
+    def _is_owned(self) -> bool:
+        return self.held_by_current_thread()
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class UnguardedAccessError(AssertionError):
+    """Raised by a :func:`guarded` proxy when shared state is touched
+    by a thread that does not hold the declared lock."""
+
+
+class _GuardedProxy:
+    __slots__ = ("_gp_obj", "_gp_lock", "_gp_label")
+
+    def __init__(self, obj, lock: InstrumentedLock, label: str):
+        object.__setattr__(self, "_gp_obj", obj)
+        object.__setattr__(self, "_gp_lock", lock)
+        object.__setattr__(self, "_gp_label", label)
+
+    def _gp_check(self, op: str) -> None:
+        lock = object.__getattribute__(self, "_gp_lock")
+        if not lock.held_by_current_thread():
+            label = object.__getattribute__(self, "_gp_label")
+            raise UnguardedAccessError(
+                f"{op} on {label} from {threading.current_thread().name} "
+                f"without holding lock {lock.name!r}")
+
+    def __getattr__(self, name):
+        _GuardedProxy._gp_check(self, f"attribute read .{name}")
+        return getattr(object.__getattribute__(self, "_gp_obj"), name)
+
+    def __setattr__(self, name, value):
+        _GuardedProxy._gp_check(self, f"attribute write .{name}")
+        setattr(object.__getattribute__(self, "_gp_obj"), name, value)
+
+    def __getitem__(self, key):
+        self._gp_check(f"read [{key!r}]")
+        return object.__getattribute__(self, "_gp_obj")[key]
+
+    def __setitem__(self, key, value):
+        self._gp_check(f"write [{key!r}]")
+        object.__getattribute__(self, "_gp_obj")[key] = value
+
+    def __delitem__(self, key):
+        self._gp_check(f"del [{key!r}]")
+        del object.__getattribute__(self, "_gp_obj")[key]
+
+    def __len__(self):
+        self._gp_check("len()")
+        return len(object.__getattribute__(self, "_gp_obj"))
+
+    def __iter__(self):
+        self._gp_check("iter()")
+        return iter(object.__getattribute__(self, "_gp_obj"))
+
+    def __contains__(self, item):
+        self._gp_check("membership test")
+        return item in object.__getattribute__(self, "_gp_obj")
+
+    def __bool__(self):
+        self._gp_check("truthiness test")
+        return bool(object.__getattribute__(self, "_gp_obj"))
+
+    def __repr__(self):
+        return (f"guarded({object.__getattribute__(self, '_gp_obj')!r}, "
+                f"lock={object.__getattribute__(self, '_gp_lock').name!r})")
+
+
+def guarded(obj, lock: InstrumentedLock,
+            label: str = "shared state") -> _GuardedProxy:
+    """Wrap ``obj`` so every access asserts ``lock`` is held by the
+    calling thread, raising :class:`UnguardedAccessError` otherwise.
+
+    This is the dynamic half of the guarded-attrs discipline: a
+    regression test swaps a component's lock for an
+    :class:`InstrumentedLock`, wraps the racy container with this
+    proxy, and replays the pre-fix interleaving under a fixed seed —
+    the unguarded touch then fails deterministically instead of
+    corrupting state one run in a thousand.
+    """
+    return _GuardedProxy(obj, lock, label)

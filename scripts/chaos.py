@@ -998,6 +998,96 @@ def scenario_dist_cutover_kill(tmp: str) -> dict:
             "faults_fired": {"replica.commit_crash": 1}}
 
 
+def scenario_race_admission(tmp: str) -> dict:
+    """Decode admission (``serving.batcher.AdmissionQueue``, the
+    continuous-batching front door) driven through adversarial seeded
+    interleavings: two producer threads offer streams while the
+    step-loop consumer takes budget-gated prefixes, with the queue's
+    lock swapped for an ``InstrumentedLock`` (every acquisition is a
+    scheduler yield point) and the deque wrapped in a ``guarded()``
+    proxy that raises the instant any access happens off-lock.
+    Asserts conservation — every offered stream ends up admitted,
+    shed, rejected, or still queued, exactly once — and that each
+    seed replays bitwise-identically (the racecheck runtime-harness
+    contract, docs/ANALYSIS.md "Racecheck")."""
+    import itertools
+
+    from perceiver_tpu.serving.batcher import AdmissionQueue
+    from perceiver_tpu.utils.concurrency import (
+        InstrumentedLock,
+        InterleaveScheduler,
+        guarded,
+    )
+
+    def run_once(seed: int):
+        sched = InterleaveScheduler(seed=seed)
+        # deterministic clock: admission/shedding decisions depend only
+        # on the seeded schedule, never on wall time
+        ticks = itertools.count()
+        q = AdmissionQueue(max_depth=8,
+                           clock=lambda: next(ticks) * 1e-3)
+        lock = InstrumentedLock(sched, name="admission._lock")
+        q._lock = lock
+        q._queue = guarded(q._queue, lock, label="admission deque")
+
+        offered, rejected = [], []
+        admitted, shed = [], []
+
+        def producer(base: int):
+            def run():
+                for i in range(6):
+                    item = f"s{base}-{i}"
+                    # every third stream carries an already-expired
+                    # deadline so the shed path interleaves too
+                    deadline = 0.0 if i % 3 == 2 else None
+                    if q.offer(item, cost=1 + (i % 3),
+                               deadline=deadline):
+                        offered.append(item)
+                    else:
+                        rejected.append(item)
+            return run
+
+        def consumer():
+            for _ in range(48):
+                a, s = q.take(budget=4, slots=2)
+                admitted.extend(a)
+                shed.extend(s)
+                if (len(offered) + len(rejected) == 12
+                        and q.depth == 0):
+                    return
+
+        sched.spawn(producer(0), name="producer-0")
+        sched.spawn(producer(1), name="producer-1")
+        sched.spawn(consumer, name="step-loop")
+        sched.run()
+        leftover = q.drain_all()
+        return (tuple(admitted), tuple(shed), tuple(rejected),
+                tuple(leftover), tuple(sched.trace))
+
+    seeds = [4, 7, 1234]
+    totals = {"admitted": 0, "shed": 0, "rejected": 0, "leftover": 0}
+    for seed in seeds:
+        first = run_once(seed)
+        admitted, shed, rejected, leftover, _trace = first
+        everything = list(admitted) + list(shed) + list(rejected) \
+            + list(leftover)
+        expect = {f"s{b}-{i}" for b in (0, 1) for i in range(6)}
+        assert sorted(everything) == sorted(expect), (
+            f"seed {seed}: streams lost or duplicated: {everything}")
+        # bitwise-reproducible: the same seed replays the same
+        # interleaving, outcomes and all
+        assert run_once(seed) == first, f"seed {seed} not deterministic"
+        totals["admitted"] += len(admitted)
+        totals["shed"] += len(shed)
+        totals["rejected"] += len(rejected)
+        totals["leftover"] += len(leftover)
+    # The injected fault here is the scheduler itself: one adversarial
+    # interleaving per seed, each replayed once to prove determinism.
+    return {"seeds": seeds, "streams_per_seed": 12,
+            "deterministic_replays": len(seeds), **totals,
+            "faults_fired": {"race.interleave": len(seeds)}}
+
+
 # scenario name -> (fault plan armed via PERCEIVER_FAULTS, fn)
 _SCENARIOS = {
     "loader_crash": ("loader.exception@at=1,count=2",
@@ -1010,6 +1100,9 @@ _SCENARIOS = {
     "preempt": ("train.preempt@at=3", scenario_preempt),
     "serve_dispatch": ("serve.dispatch@at=1,count=4",
                        scenario_serve_dispatch),
+    # race_admission arms no fault plan: the "fault" is the adversarial
+    # thread interleaving itself (racecheck runtime harness)
+    "race_admission": (None, scenario_race_admission),
     # fleet scenarios arm faults per-REPLICA (supervisor env overrides)
     # rather than in the scenario child, so the plan column stays None
     "fleet_kill_replica": (None, scenario_fleet_kill_replica),
@@ -1024,8 +1117,8 @@ _SCENARIOS = {
     "dist_cutover_kill": (None, scenario_dist_cutover_kill),
 }
 _MATRIX = ["loader_crash", "nan_skip", "nan_rewind", "truncated_ckpt",
-           "kill_save", "preempt", "serve_dispatch"]
-_FAST = ["nan_skip", "serve_dispatch"]
+           "kill_save", "preempt", "serve_dispatch", "race_admission"]
+_FAST = ["nan_skip", "serve_dispatch", "race_admission"]
 _FLEET_MATRIX = ["fleet_kill_replica", "fleet_stall",
                  "fleet_rollout_corrupt", "fleet_rollout"]
 _FLEET_FAST = ["fleet_kill_replica"]
@@ -1106,8 +1199,10 @@ def main() -> int:
         ap.error(f"unknown scenario(s) {unknown}")
     results, ok = [], True
     for name in names:
-        print(f"[chaos] {name}: injecting "
-              f"{_SCENARIOS[name][0] or 'kill -9 (grand-child)'} ...",
+        fault = _SCENARIOS[name][0] or (
+            "adversarial interleaving (seeded scheduler)"
+            if name == "race_admission" else "kill -9 (grand-child)")
+        print(f"[chaos] {name}: injecting {fault} ...",
               file=sys.stderr, flush=True)
         t0 = time.perf_counter()
         with tempfile.TemporaryDirectory(prefix=f"chaos-{name}-") as tmp:

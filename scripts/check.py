@@ -5,6 +5,7 @@ Usage::
 
     python scripts/check.py --all           # everything (the merge gate)
     python scripts/check.py --lint          # AST rules only (fast)
+    python scripts/check.py --race          # racecheck passes only
     python scripts/check.py --graph         # graph passes, all targets
     python scripts/check.py --graph --fast  # skip the expensive targets
                                             # and the double-lowering
@@ -44,6 +45,12 @@ def main() -> int:
                     help="run the AST lint rules")
     ap.add_argument("--graph", action="store_true",
                     help="run the lowered-graph passes")
+    ap.add_argument("--race", action="store_true",
+                    help="run the racecheck passes (guarded-attrs, "
+                         "lock-order, callback-under-lock) over the "
+                         "concurrent host-side packages")
+    ap.add_argument("--no-race", action="store_true",
+                    help="escape hatch: drop racecheck from --all")
     ap.add_argument("--fast", action="store_true",
                     help="graph passes on the fast targets only, "
                          "without the double-lowering recompile check")
@@ -89,9 +96,9 @@ def main() -> int:
                          "shard_budgets.json (existing pins copied "
                          "through untouched)")
     args = ap.parse_args()
-    if not (args.all or args.lint or args.graph or args.rebaseline_hbm
-            or args.pin_missing_hbm or args.rebaseline_shard
-            or args.pin_missing_shard):
+    if not (args.all or args.lint or args.graph or args.race
+            or args.rebaseline_hbm or args.pin_missing_hbm
+            or args.rebaseline_shard or args.pin_missing_shard):
         args.all = True
 
     from perceiver_tpu.analysis import (
@@ -103,6 +110,7 @@ def main() -> int:
         lint_paths,
         lower_target,
         run_graph_checks,
+        run_racecheck,
         write_hbm_budgets,
         write_shard_budgets,
     )
@@ -138,7 +146,7 @@ def main() -> int:
             print("[check] hbm_budgets.json rewritten — commit it with "
                   "the change that justified the re-baseline",
                   file=sys.stderr)
-        if not (args.all or args.lint or args.graph
+        if not (args.all or args.lint or args.graph or args.race
                 or args.rebaseline_shard or args.pin_missing_shard):
             return 0
 
@@ -189,7 +197,7 @@ def main() -> int:
             print("[check] shard_budgets.json rewritten — commit it "
                   "with the change that justified the re-baseline",
                   file=sys.stderr)
-        if not (args.all or args.lint or args.graph):
+        if not (args.all or args.lint or args.graph or args.race):
             return 0
 
     cache = None
@@ -212,6 +220,10 @@ def main() -> int:
         print(f"[check] linting {len(paths)} root(s) ...",
               file=sys.stderr)
         report.merge(lint_paths(paths))
+    if (args.all and not args.no_race) or args.race:
+        print("[check] racecheck over the concurrent host-side "
+              "packages ...", file=sys.stderr)
+        report.merge(run_racecheck(repo_root=_REPO))
     if args.all or args.graph:
         targets = FAST_TARGETS if args.fast else CANONICAL_TARGETS
         if args.no_mesh:

@@ -1090,9 +1090,11 @@ def test_check_cli_all_exits_zero():
     m = re.search(r"from (\d+) check\(s\): (.*)", r.stdout)
     assert m, r.stdout
     n_checks, roster = int(m.group(1)), m.group(2)
-    assert n_checks >= 18, r.stdout
+    assert n_checks >= 23, r.stdout
     for shard_pass in ("collective_budget", "replication_check",
-                       "per_shard_hbm_budget", "unsharded-pjit"):
+                       "per_shard_hbm_budget", "unsharded-pjit",
+                       "guarded-attrs", "lock-order",
+                       "callback-under-lock", "blocking-under-lock"):
         assert shard_pass in roster, r.stdout
     m = re.search(r"lowering (\d+) canonical target", r.stderr)
     assert m and int(m.group(1)) == len(CANONICAL_TARGETS), r.stderr

@@ -1,0 +1,761 @@
+"""Self-verification of the racecheck subsystem (ISSUE 16).
+
+Same contract as test_graphcheck.py: every pass must demonstrably
+FAIL on a seeded violation — a lock-discipline gate that cannot catch
+an unlocked access certifies code it never checked. Each static pass
+(guarded-attrs, lock-order, callback-under-lock) gets a tiny synthetic
+module that violates it plus a clean twin; the allowlist and the
+suppression comment round-trip; a corrupt registry fails loudly; and
+the end-to-end run over the real tree exits clean, both in-process
+and as the literal ``scripts/check.py --race`` subprocess.
+
+The second half proves the *runtime* harness: the InterleaveScheduler
+replays a seeded interleaving bitwise-identically, and the real
+concurrency fixes this PR landed (Router health writes under the
+router lock, ParamsVersionStore CURRENT-pointer serialization) each
+get a deterministic regression test whose pre-fix shape fails under a
+fixed seed while the fixed code runs clean under the same one.
+"""
+
+import ast
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from perceiver_tpu.analysis import RaceAllow, run_racecheck
+from perceiver_tpu.analysis.lint import lint_source
+from perceiver_tpu.analysis.racecheck import (
+    check_callback_under_lock,
+    check_guarded_attrs,
+    check_lock_order_cycles,
+    collect_lock_order_edges,
+)
+from perceiver_tpu.utils.concurrency import (
+    InstrumentedLock,
+    InterleaveScheduler,
+    SchedPoint,
+    UnguardedAccessError,
+    guarded,
+    guarded_by,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse(src):
+    return ast.parse(textwrap.dedent(src))
+
+
+def _race_file(tmp_path, src, name="fake.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+# --- pass 1: guarded-attrs --------------------------------------------------
+
+
+GUARDED_ESCAPE = """
+import threading
+
+class Store:
+    _GUARDED = {"items": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def bad_add(self, x):
+        self.items.append(x)        # <- unlocked touch
+
+    def good_add(self, x):
+        with self._lock:
+            self.items.append(x)
+"""
+
+
+def test_guarded_attrs_fails_on_seeded_escape():
+    vs = check_guarded_attrs(_parse(GUARDED_ESCAPE), "fake.py")
+    assert len(vs) == 1, vs
+    v = vs[0]
+    assert v.check == "guarded-attrs"
+    assert "Store.bad_add" in v.message and "'items'" in v.message
+    # __init__ and the locked method are exempt/clean
+    clean = GUARDED_ESCAPE.replace(
+        "self.items.append(x)        # <- unlocked touch",
+        "pass")
+    assert check_guarded_attrs(_parse(clean), "fake.py") == []
+
+
+def test_guarded_attrs_star_and_dotted_keys():
+    src = """
+    import threading
+
+    class Mgr:
+        _GUARDED = {"*.count": "_lock", "stats.hits": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self, rec):
+            rec.count += 1
+            self.stats.hits += 1
+
+        def good(self, rec):
+            with self._lock:
+                rec.count += 1
+                self.stats.hits += 1
+    """
+    vs = check_guarded_attrs(_parse(src), "fake.py")
+    assert {v.message.split("'")[1] for v in vs} == {"count",
+                                                     "stats.hits"}
+    assert all("Mgr.bad" in v.message for v in vs), vs
+
+
+def test_guarded_attrs_condition_alias_counts_as_lock():
+    src = """
+    import threading
+
+    class Q:
+        _GUARDED = {"_q": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+
+        def pop(self):
+            with self._cv:          # Condition over _lock: holds it
+                return self._q.pop()
+    """
+    assert check_guarded_attrs(_parse(src), "fake.py") == []
+
+
+def test_guarded_attrs_tuple_value_accepts_either_lock():
+    src = """
+    import threading
+
+    class Q:
+        _GUARDED = {"_q": ("_lock", "_not_empty")}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def via_cond(self):
+            with self._not_empty:
+                return len(self._q)
+
+        def bad(self):
+            return len(self._q)
+    """
+    vs = check_guarded_attrs(_parse(src), "fake.py")
+    assert len(vs) == 1 and "Q.bad" in vs[0].message, vs
+
+
+def test_locked_suffix_convention():
+    src = """
+    import threading
+
+    class C:
+        _GUARDED = {"_state": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def _flush_locked(self):
+            self._state.clear()     # exempt: callee-side lock-held
+
+        def good(self):
+            with self._lock:
+                self._flush_locked()
+
+        def bad(self):
+            self._flush_locked()    # call site outside any lock frame
+    """
+    vs = check_guarded_attrs(_parse(src), "fake.py")
+    assert len(vs) == 1, vs
+    assert "C.bad" in vs[0].message and "_flush_locked" in vs[0].message
+
+
+def test_nested_def_analyzed_with_no_locks_held():
+    # a closure defined under the lock may run later on another
+    # thread — its guarded touches must still be flagged
+    src = """
+    import threading
+
+    class C:
+        _GUARDED = {"_state": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            with self._lock:
+                def later():
+                    return self._state
+                return later
+    """
+    vs = check_guarded_attrs(_parse(src), "fake.py")
+    assert len(vs) == 1 and "'_state'" in vs[0].message, vs
+
+
+def test_guarded_globals_registry(tmp_path):
+    src = """
+    import threading
+
+    _lock = threading.Lock()
+    _cache = {}
+
+    _GUARDED_GLOBALS = {"_cache": "_lock"}
+
+    def bad():
+        return _cache.get("k")
+
+    def good():
+        with _lock:
+            return _cache.get("k")
+    """
+    vs = check_guarded_attrs(_parse(src), "fake.py")
+    assert len(vs) == 1, vs
+    assert "_cache" in vs[0].message and "_GUARDED_GLOBALS" in vs[0].message
+
+
+def test_registry_corruption_fails_loudly():
+    for bad_registry in (
+            '_GUARDED = "items->lock"',            # not a dict
+            '_GUARDED = {1: "_lock"}',             # non-string key
+            '_GUARDED = {"items": 7}',             # non-string value
+    ):
+        src = f"""
+        class C:
+            {bad_registry}
+            def f(self):
+                pass
+        """
+        vs = check_guarded_attrs(_parse(src), "fake.py")
+        assert len(vs) == 1, (bad_registry, vs)
+        assert "corrupt" in vs[0].message, vs[0].message
+    # the runtime half enforces the same contract
+    with pytest.raises(TypeError):
+        guarded_by("", "x")
+    with pytest.raises(TypeError):
+        guarded_by("_lock")
+    with pytest.raises(TypeError):
+        guarded_by("_lock", 3)
+
+
+def test_guarded_by_decorator_builds_registry():
+    @guarded_by("_lock", "a", "b")
+    class C:
+        pass
+
+    assert C._GUARDED == {"a": "_lock", "b": "_lock"}
+
+    @guarded_by("_other", "c")
+    class D(C):
+        pass
+
+    # merges with (and inherits) the base registry
+    assert D._GUARDED == {"a": "_lock", "b": "_lock", "c": "_other"}
+    assert C._GUARDED == {"a": "_lock", "b": "_lock"}
+
+
+# --- pass 2: lock-order -----------------------------------------------------
+
+
+LOCK_CYCLE = """
+import threading
+
+class A:
+    def __init__(self):
+        self._lock_x = threading.Lock()
+        self._lock_y = threading.Lock()
+
+    def forward(self):
+        with self._lock_x:
+            with self._lock_y:
+                pass
+
+    def backward(self):
+        with self._lock_y:
+            with self._lock_x:
+                pass
+"""
+
+
+def test_lock_order_cycle_detected():
+    edges, selfv = collect_lock_order_edges(_parse(LOCK_CYCLE), "fake.py")
+    assert selfv == []
+    assert len(edges) == 2
+    vs = check_lock_order_cycles(edges)
+    assert len(vs) == 1, vs
+    assert vs[0].check == "lock-order"
+    assert "cycle" in vs[0].message
+    # consistent order on both paths -> clean
+    clean = LOCK_CYCLE.replace("with self._lock_y:\n            "
+                               "with self._lock_x:",
+                               "with self._lock_x:\n            "
+                               "with self._lock_y:")
+    edges, _ = collect_lock_order_edges(_parse(clean), "fake.py")
+    assert check_lock_order_cycles(edges) == []
+
+
+def test_lock_order_self_deadlock_and_rlock_exemption():
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def boom(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """
+    _, selfv = collect_lock_order_edges(_parse(src), "fake.py")
+    assert len(selfv) == 1 and "self-deadlock" in selfv[0].message, selfv
+    rlock = src.replace("threading.Lock()", "threading.RLock()")
+    _, selfv = collect_lock_order_edges(_parse(rlock), "fake.py")
+    assert selfv == []
+
+
+def test_lock_order_graph_is_global_across_classes():
+    # the cycle only exists once edges from BOTH classes land in one
+    # graph — two components taking shared module-level locks in
+    # opposite orders, neither wrong in isolation
+    fwd = """
+    import threading
+
+    lock_one = threading.Lock()
+    lock_two = threading.Lock()
+
+    class A:
+        def f(self):
+            with lock_one:
+                with lock_two:
+                    pass
+    """
+    bwd = """
+    class B:
+        def g(self):
+            with lock_two:
+                with lock_one:
+                    pass
+    """
+    ea, _ = collect_lock_order_edges(_parse(fwd), "fake.py")
+    eb, _ = collect_lock_order_edges(_parse(bwd), "fake.py")
+    assert check_lock_order_cycles(ea) == []
+    assert check_lock_order_cycles(eb) == []
+    vs = check_lock_order_cycles(list(ea) + list(eb))
+    assert len(vs) == 1 and "cycle" in vs[0].message, vs
+    # and the combined module trips end-to-end through the one-shot API
+    both, _ = collect_lock_order_edges(
+        _parse(fwd + bwd), "fake.py")
+    assert len(check_lock_order_cycles(both)) == 1
+
+
+# --- pass 3: callback-under-lock --------------------------------------------
+
+
+CALLBACK_UNDER_LOCK = """
+import threading
+
+class Breaker:
+    def __init__(self, on_transition):
+        self._lock = threading.Lock()
+        self.on_transition = on_transition
+
+    def trip(self):
+        with self._lock:
+            self.on_transition("closed", "open")   # PR 5 shape
+"""
+
+
+def test_callback_under_lock_detected():
+    vs = check_callback_under_lock(_parse(CALLBACK_UNDER_LOCK), "fake.py")
+    assert len(vs) == 1, vs
+    assert vs[0].check == "callback-under-lock"
+    assert "on_transition" in vs[0].message
+    # snapshot-then-fire outside the lock is the sanctioned shape
+    clean = """
+    import threading
+
+    class Breaker:
+        def __init__(self, on_transition):
+            self._lock = threading.Lock()
+            self.on_transition = on_transition
+
+        def trip(self):
+            with self._lock:
+                old, new = "closed", "open"
+            self.on_transition(old, new)
+    """
+    assert check_callback_under_lock(_parse(clean), "fake.py") == []
+
+
+# --- allowlist + suppression round-trips ------------------------------------
+
+
+def test_allowlist_round_trip(tmp_path):
+    path = _race_file(tmp_path, GUARDED_ESCAPE)
+    r = run_racecheck(paths=[path], allowlist=())
+    assert not r.ok and len(r.violations) == 1
+    r = run_racecheck(paths=[path], allowlist=(
+        RaceAllow(attr="Store.items",
+                  reason="seeded test escape"),))
+    assert r.ok, r.format()
+    # the budget is per-entry: a second unlocked touch still fails
+    two = GUARDED_ESCAPE + "\n    def also_bad(self):\n" \
+                           "        return len(self.items)\n"
+    path2 = _race_file(tmp_path, two, "two.py")
+    r = run_racecheck(paths=[path2], allowlist=(
+        RaceAllow(attr="Store.items",
+                  reason="seeded test escape"),))
+    assert not r.ok and len(r.violations) == 1, r.format()
+    r = run_racecheck(paths=[path2], allowlist=(
+        RaceAllow(attr="Store.items",
+                  reason="seeded test escape", max_count=2),))
+    assert r.ok, r.format()
+
+
+def test_suppression_comment_round_trip(tmp_path):
+    suppressed = GUARDED_ESCAPE.replace(
+        "# <- unlocked touch", "# graphcheck: ignore")
+    path = _race_file(tmp_path, suppressed)
+    r = run_racecheck(paths=[path], allowlist=())
+    assert r.ok, r.format()
+
+
+# --- satellite: blocking-under-lock + condition hygiene (lint) --------------
+
+
+BLOCKING_UNDER_LOCK = """
+import pickle
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self, sock):
+        with self._lock:
+            time.sleep(0.1)
+            blob = pickle.dumps({})
+            sock.sendall(blob)
+            open("/tmp/x")
+            send_msg(sock, {}, 1.0)
+
+    def good(self, sock):
+        with self._lock:
+            blob = dict(x=1)
+        sock.sendall(pickle.dumps(blob))
+"""
+
+
+def test_blocking_under_lock_seeded():
+    vs = [v for v in lint_source(textwrap.dedent(BLOCKING_UNDER_LOCK),
+                                 "perceiver_tpu/serving/fake.py")
+          if v.check == "blocking-under-lock"]
+    assert len(vs) == 5, vs
+    for needle in ("time.sleep", "pickle.dumps", "sendall", "open()",
+                   "send_msg"):
+        assert any(needle in v.message for v in vs), (needle, vs)
+    # out of scope: the same source under obs/ is not checked
+    assert [v for v in lint_source(textwrap.dedent(BLOCKING_UNDER_LOCK),
+                                   "perceiver_tpu/obs/fake.py")
+            if v.check == "blocking-under-lock"] == []
+
+
+def test_blocking_under_lock_nested_def_resets_frame():
+    src = """
+    import threading, time
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def ok(self):
+            with self._lock:
+                def later():
+                    time.sleep(0.1)   # runs after release
+                return later
+    """
+    assert [v for v in lint_source(textwrap.dedent(src),
+                                   "perceiver_tpu/serving/fake.py")
+            if v.check == "blocking-under-lock"] == []
+
+
+def test_condition_wait_requires_timeout():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._not_empty = threading.Condition(self._lock)
+
+        def bad(self):
+            with self._not_empty:
+                self._not_empty.wait()
+
+        def good(self):
+            with self._not_empty:
+                self._not_empty.wait(0.05)
+    """
+    vs = [v for v in lint_source(textwrap.dedent(src),
+                                 "perceiver_tpu/fleet/fake.py")
+          if v.check == "distributed-blocking-io"]
+    assert len(vs) == 1 and "wait() with no timeout" in vs[0].message, vs
+    # keyword timeout also passes
+    kw = src.replace("self._not_empty.wait()",
+                     "self._not_empty.wait(timeout=0.05)")
+    assert [v for v in lint_source(textwrap.dedent(kw),
+                                   "perceiver_tpu/fleet/fake.py")
+            if v.check == "distributed-blocking-io"] == []
+
+
+# --- end-to-end over the real tree ------------------------------------------
+
+
+def test_racecheck_real_tree_clean():
+    r = run_racecheck(repo_root=ROOT)
+    assert r.ok, r.format()
+    assert set(r.checks_run) == {"guarded-attrs", "lock-order",
+                                 "callback-under-lock"}
+
+
+def test_check_cli_race_exits_zero():
+    """``scripts/check.py --race`` — the literal CI face — exits 0 on
+    this tree and reports all three passes in the roster."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "check.py"),
+         "--race"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+    for name in ("guarded-attrs", "lock-order", "callback-under-lock"):
+        assert name in r.stdout, r.stdout
+
+
+# --- the deterministic interleaving harness ---------------------------------
+
+
+def _two_thread_trace(seed):
+    sched = InterleaveScheduler(seed=seed)
+    log = []
+
+    def worker(tag):
+        def run():
+            for i in range(3):
+                log.append((tag, i))
+                sched.point(f"{tag}:{i}")
+        return run
+
+    sched.spawn(worker("a"), name="a")
+    sched.spawn(worker("b"), name="b")
+    sched.run()
+    return list(sched.trace), list(log)
+
+
+def test_interleaving_is_seed_deterministic():
+    t1, l1 = _two_thread_trace(seed=1234)
+    t2, l2 = _two_thread_trace(seed=1234)
+    assert t1 == t2 and l1 == l2
+    assert {name for name, _ in t1} == {"a", "b"}
+    assert len(l1) == 6
+
+
+def test_scheduler_reraises_worker_exception():
+    sched = InterleaveScheduler(seed=0)
+
+    def boom():
+        sched.point("pre")
+        raise ValueError("seeded failure")
+
+    sched.spawn(boom, name="boom")
+    with pytest.raises(ValueError, match="seeded failure"):
+        sched.run()
+
+
+def test_instrumented_lock_tracks_ownership():
+    lock = InstrumentedLock(name="t")
+    assert not lock.held_by_current_thread()
+    with lock:
+        assert lock.held_by_current_thread()
+        assert lock.locked()
+    assert not lock.held_by_current_thread()
+    assert lock.acquisitions == 1
+    # non-blocking contention path
+    assert lock.acquire(blocking=False)
+    assert not lock.acquire(blocking=False)
+    lock.release()
+    # a threading.Condition accepts it as its lock (_is_owned protocol)
+    cond = __import__("threading").Condition(InstrumentedLock(name="c"))
+    with cond:
+        cond.wait(0.01)
+
+
+def test_guarded_proxy_raises_off_lock():
+    lock = InstrumentedLock(name="g")
+    state = guarded({"k": 1}, lock, label="test dict")
+    with pytest.raises(UnguardedAccessError):
+        state["k"]
+    with pytest.raises(UnguardedAccessError):
+        state["k"] = 2
+    with pytest.raises(UnguardedAccessError):
+        len(state)
+    with pytest.raises(UnguardedAccessError):
+        "k" in state
+    with lock:
+        state["k"] = 2
+        assert state["k"] == 2
+        assert len(state) == 1
+
+
+def test_sched_point_shim_is_noop_off_harness():
+    sched = InterleaveScheduler(seed=0)
+    hook = SchedPoint(sched, "shim")
+    hook()  # unmanaged thread: must not park or deadlock
+    assert sched.trace == []
+
+
+# --- regression: Router health writes under the router lock -----------------
+# The fix this PR landed in fleet/router.py: submit() and _probe_loop()
+# used to write `state.health = ...` with no lock while _pick() read it
+# under self._lock on other threads. These tests instrument the REAL
+# Router: if the `with self._lock:` around either write is ever
+# removed again, the guard below raises deterministically.
+
+
+class _FakeHandle:
+    def __init__(self, health="DEGRADED"):
+        self.health = health
+
+    def dispatch(self, arrays):
+        return {"outputs": dict(arrays), "health": self.health}
+
+    def status(self):
+        return {"health": self.health}
+
+
+def _guarded_router(sched, seed_note=""):
+    from perceiver_tpu.fleet.router import Router
+
+    router = Router(prober_interval_s=None, max_attempts=2)
+    ilock = InstrumentedLock(sched, name="router._lock")
+    router._lock = ilock
+    router.add("r0", _FakeHandle())
+    state = router._replicas["r0"]
+
+    class _HealthWriteGuarded(state.__class__):
+        def __setattr__(self, name, value):
+            if name == "health" and not ilock.held_by_current_thread():
+                raise UnguardedAccessError(
+                    "health written without holding the router lock "
+                    f"({seed_note})")
+            super().__setattr__(name, value)
+
+    state.__class__ = _HealthWriteGuarded
+    return router, state
+
+
+def test_router_prefix_health_write_fails_deterministically():
+    # the literal pre-fix statement shape: raises on every run, no
+    # timing involved — this is what turns the race into an assertion
+    router, state = _guarded_router(None, seed_note="pre-fix shape")
+    with pytest.raises(UnguardedAccessError):
+        state.health = "UNAVAILABLE"       # verbatim pre-fix write
+    with router._lock:
+        state.health = "UNAVAILABLE"       # the fixed shape
+
+
+def test_router_submit_health_write_holds_lock_under_interleaving():
+    # two submitters race through the real submit() under a seeded
+    # schedule; the instrumented lock yields at every acquisition, so
+    # the health write interleaves against _pick on the sibling thread
+    def run_once(seed):
+        sched = InterleaveScheduler(seed=seed)
+        router, state = _guarded_router(sched, seed_note=f"seed={seed}")
+        results = []
+        sched.spawn(lambda: results.append(router.submit({"x": 1})),
+                    name="submit-a")
+        sched.spawn(lambda: results.append(router.submit({"x": 2})),
+                    name="submit-b")
+        sched.run()
+        return results, list(sched.trace), state
+
+    for seed in (0, 7, 1234):
+        results, trace, state = run_once(seed)
+        assert len(results) == 2, results
+        assert state.health == "DEGRADED"  # reply health took effect
+        # bitwise-reproducible: same seed, same interleaving
+        results2, trace2, _ = run_once(seed)
+        assert trace == trace2 and len(results2) == 2
+
+
+# --- regression: ParamsVersionStore CURRENT pointer serialization ----------
+# The fix this PR landed in training/checkpoint.py: two threads of one
+# process share the pid-suffixed CURRENT temp name; unserialized, one
+# thread's os.replace() consumes the temp file out from under the
+# sibling mid-write. The pre-fix body (reproduced verbatim below with
+# a yield point in the write→replace window) fails deterministically
+# under the seeded schedule; the real, locked set_current survives the
+# same schedule.
+
+
+def _prefix_set_current(directory, version, point):
+    # verbatim pre-fix body of ParamsVersionStore.set_current, with a
+    # sched point in the racy window between write and replace
+    tmp = os.path.join(directory, f".CURRENT.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(version + "\n")
+    point()
+    os.replace(tmp, os.path.join(directory, "CURRENT"))
+
+
+# the losing interleaving is any schedule where both threads pass the
+# temp-file write before either replaces it; under Random(4) the drawn
+# schedule is exactly that (both writes park before a replace runs),
+# so the failure replays on every run — no luck involved
+RACY_SEED = 4
+
+
+def test_params_version_store_prefix_race_fails_deterministically(
+        tmp_path):
+    seed = RACY_SEED
+    sched = InterleaveScheduler(seed=seed)
+    point = SchedPoint(sched, "write->replace window")
+    sched.spawn(lambda: _prefix_set_current(str(tmp_path), "v1", point),
+                name="a")
+    sched.spawn(lambda: _prefix_set_current(str(tmp_path), "v2", point),
+                name="b")
+    with pytest.raises(FileNotFoundError):
+        sched.run()
+
+
+def test_params_version_store_set_current_survives_same_schedule(
+        tmp_path):
+    from perceiver_tpu.training.checkpoint import ParamsVersionStore
+
+    store = ParamsVersionStore(str(tmp_path))
+    os.makedirs(store.path("v1"))
+    os.makedirs(store.path("v2"))
+    seed = RACY_SEED
+    sched = InterleaveScheduler(seed=seed)
+    store._lock = InstrumentedLock(sched, name="store._lock")
+    sched.spawn(lambda: store.set_current("v1"), name="a")
+    sched.spawn(lambda: store.set_current("v2"), name="b")
+    sched.run()  # no FileNotFoundError: the lock serializes the window
+    assert store.current() in {"v1", "v2"}
+    # and replay is deterministic: the winner is seed-stable
+    store2 = ParamsVersionStore(str(tmp_path))
+    sched2 = InterleaveScheduler(seed=RACY_SEED)
+    store2._lock = InstrumentedLock(sched2, name="store._lock")
+    sched2.spawn(lambda: store2.set_current("v1"), name="a")
+    sched2.spawn(lambda: store2.set_current("v2"), name="b")
+    sched2.run()
+    assert store2.current() == store.current()
