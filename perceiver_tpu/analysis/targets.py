@@ -235,11 +235,13 @@ def make_packed_serve_step(task, batch):
 
 
 def make_decode_step(task, batch):
-    """The autoregressive decode step jit — the exact executable
+    """The unified prefill+decode step jit — the exact executable
     ``DecodeEngine`` AOT-compiles once per pool geometry and then runs
-    for every token of every stream (serving/decode.py). ``batch``
-    carries the ``DecodeGeometry`` plus one round of per-slot
-    ``tokens``/``active`` inputs. Returns
+    for every step of every stream (serving/decode.py). ``batch``
+    carries the ``DecodeGeometry`` plus one MIXED-phase round of
+    per-slot ``tokens`` (streams × max_chunk lanes) and ``qlens``
+    (chunked-prefill rows feed >1 token, decode rows feed 1) — the
+    gates certify the single signature both phases share. Returns
     ``(jitted_fn, args, expected_donated)``: the whole carry (KV pools,
     lengths, page tables) is donated — every leaf aliases an output, so
     the step's HBM high-water mark is ONE copy of the paged cache."""
@@ -251,7 +253,7 @@ def make_decode_step(task, batch):
                                attn_impl=batch.get("attn_impl", "pallas"))
     params = graph.init_params()
     carry = graph.init_carry()
-    args = (params, carry, batch["tokens"], batch["active"])
+    args = (params, carry, batch["tokens"], batch["qlens"])
     jitted = jax.jit(graph.fn, donate_argnums=graph.donate_argnums)
     expected = len(jax.tree_util.tree_leaves(carry))
     return jitted, args, expected
@@ -259,7 +261,7 @@ def make_decode_step(task, batch):
 
 def make_sharded_decode_step(task, batch, mesh):
     """The sharded decode step: params tensor-parallel (``model``),
-    per-stream rows (tokens/active/lengths/page tables) batch-sharded
+    per-stream rows (tokens/qlens/lengths/page tables) batch-sharded
     over ``data``, and the KV pools replicated — each pool is a shared
     arena indexed by data-local page tables, and at canonical geometry
     it sits far below the replication floor (the replication pass still
@@ -285,10 +287,14 @@ def make_sharded_decode_step(task, batch, mesh):
         "lengths": row,
         "page_tables": NamedSharding(mesh, P("data", None)),
     }
-    args = (params, carry, batch["tokens"], batch["active"])
+    args = (params, carry, batch["tokens"], batch["qlens"])
+    # tokens are (streams, max_chunk): rows shard on data, the chunk
+    # lanes stay local to the row's device
+    tok_sh = NamedSharding(mesh, P("data", None))
     jitted = jax.jit(
         graph.fn, donate_argnums=graph.donate_argnums,
-        in_shardings=(param_sharding(params, mesh), carry_sh, row, row),
+        in_shardings=(param_sharding(params, mesh), carry_sh, tok_sh,
+                      row),
         out_shardings=(carry_sh,
                        {name: row for name in graph.output_names}))
     expected = len(jax.tree_util.tree_leaves(carry))
@@ -636,19 +642,22 @@ PACKED_SERVING_TARGETS = (
 
 
 # --------------------------------------------------------------------------
-# Decode targets: ONE stepped executable per pool geometry — the step
-# DecodeEngine runs for every token of every stream. The canonical
-# geometry is 8 slots over a 64-page × 16-token shared KV pool at the
-# BASELINE MLM recipe shapes. The hbm_budget pin on this target IS the
-# O(1) memory gate for the paged-decode claim: the step's bytes
-# accessed are geometry-bound (pools + params), independent of how
-# many tokens any stream has generated — a regression that makes cost
-# grow with sequence position would move the pin.
+# Decode targets: ONE stepped executable per pool geometry — the
+# unified step DecodeEngine runs for chunked prefill AND decode. The
+# canonical geometry is 8 slots over a 64-page × 16-token shared KV
+# pool with 8 chunk lanes, at the BASELINE MLM recipe shapes. The
+# batch is deliberately MIXED-phase (half the rows prefill a full
+# chunk, half decode one token) so the gates certify the signature
+# both phases share. The hbm_budget pin on this target IS the O(1)
+# memory gate for the paged-decode claim: the step's bytes accessed
+# are geometry-bound (pools + params), independent of how many tokens
+# any stream has generated — a regression that makes cost grow with
+# sequence position would move the pin.
 
 def _decode_batch_mlm(vocab: int = 10003, seq: int = 512,
                       channels: int = 64, streams: int = 8,
                       num_pages: int = 64, page_size: int = 16,
-                      attn_impl: str = "pallas"):
+                      max_chunk: int = 8, attn_impl: str = "pallas"):
     import jax.numpy as jnp
     import numpy as np
 
@@ -658,13 +667,16 @@ def _decode_batch_mlm(vocab: int = 10003, seq: int = 512,
     task = MaskedLanguageModelTask(
         vocab_size=vocab, max_seq_len=seq, num_latent_channels=channels)
     rng = np.random.default_rng(0)
+    # alternate prefill (full chunk) and decode (1 token) rows
+    qlens = np.array([max_chunk if i % 2 == 0 else 1
+                      for i in range(streams)], np.int32)
     return task, {
         "geometry": DecodeGeometry(
             max_streams=streams, num_pages=num_pages,
-            page_size=page_size, max_seq_len=seq),
+            page_size=page_size, max_seq_len=seq, max_chunk=max_chunk),
         "tokens": jnp.asarray(
-            rng.integers(3, vocab, (streams,)), jnp.int32),
-        "active": jnp.ones((streams,), jnp.bool_),
+            rng.integers(3, vocab, (streams, max_chunk)), jnp.int32),
+        "qlens": jnp.asarray(qlens),
         "attn_impl": attn_impl,
     }
 
@@ -678,8 +690,8 @@ def _decode_batch_mlm_spmd():
 
 
 DECODE_TARGETS = (
-    StepTarget(name="decode_mlm_r8_p64x16", build=_decode_batch_mlm,
-               kind="decode"),
+    StepTarget(name="decode_mixed_mlm_r8_p64x16_q8",
+               build=_decode_batch_mlm, kind="decode"),
 )
 
 
@@ -723,7 +735,7 @@ SHARDED_TARGETS = (
     StepTarget(name="serve_mlm_spmd_b32_s256_dp2_tp2",
                build=_serve_batch_mlm_spmd, kind="serve", mesh=DP2_TP2,
                replication_allow=_SPMD_MLM_EMBED_ALLOW),
-    StepTarget(name="decode_mlm_spmd_r8_p48x16_dp2_tp2",
+    StepTarget(name="decode_mixed_mlm_spmd_r8_p48x16_q8_dp2_tp2",
                build=_decode_batch_mlm_spmd, kind="decode",
                mesh=DP2_TP2,
                replication_allow=_SPMD_MLM_EMBED_ALLOW,

@@ -148,9 +148,13 @@ class ReplicaServer:
             dspec = dict(spec["decode"])
             self._decode_max_new = int(dspec.pop("max_new_tokens_default",
                                                  16))
+            # host-side pacing knob of the unified prefill+decode
+            # scheduler; everything left in dspec is geometry
+            token_budget = dspec.pop("token_budget", None)
             self.decode_engine = DecodeEngine(
                 task, self.engine._params_src,
                 geometry=DecodeGeometry(**dspec),
+                token_budget=token_budget,
                 metrics=self.engine.metrics)
         self.server = RpcServer(self.handle,
                                 port=int(spec.get("port", 0)),

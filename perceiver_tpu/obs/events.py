@@ -70,8 +70,13 @@ SCHEMA: Dict[str, Tuple[str, ...]] = {
     "cutover_ack": ("replica", "version"),
     "cutover_rollback": ("replica", "version"),
     # autoregressive decode streams (serving/decode.py): one open /
-    # close pair per stream; "tokens" = generated count at close
+    # close pair per stream; "tokens" = generated count at close.
+    # stream_admitted fires when the unified scheduler grants a slot
+    # + pages; prefill_complete when the last prompt chunk lands
+    # ("chunks" = chunked-prefill steps the prompt took).
     "stream_open": ("stream",),
+    "stream_admitted": ("stream", "pages"),
+    "prefill_complete": ("stream", "prompt_tokens", "chunks"),
     "stream_close": ("stream", "tokens"),
 }
 

@@ -66,6 +66,7 @@ PHASES = (
     "rpc_hop",      # router: one RPC attempt against one replica
     "retry",        # router: backoff + re-pick after a failed hop
     "decode_step",  # decode engine: one stepped-executable iteration
+    "prefill_chunk",  # decode engine: one chunked-prefill slice of a prompt
     "token_emit",   # decode engine: one generated token handed out
 )
 

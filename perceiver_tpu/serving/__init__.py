@@ -7,7 +7,8 @@ Layers, bottom to top:
 - ``engine``  — checkpoint loading, per-(batch, seq) bucket AOT
   compilation, device-resident params, sync-free dispatch;
 - ``batcher`` — thread-safe micro-batching queue with deadlines and
-  typed ``Overloaded`` load shedding, plus the decode admission queue;
+  typed ``Overloaded`` load shedding, plus the unified prefill+decode
+  continuous-batching scheduler (``ContinuousBatchScheduler``);
 - ``decode``  — autoregressive streaming generation: O(1) paged KV
   caching through one AOT-compiled stepped executable;
 - ``errors``  — the typed failure vocabulary (``Unavailable``,
@@ -23,6 +24,7 @@ Layers, bottom to top:
 
 from perceiver_tpu.serving.batcher import (  # noqa: F401
     AdmissionQueue,
+    ContinuousBatchScheduler,
     MicroBatcher,
     Overloaded,
     TokenBudgetBatcher,

@@ -26,6 +26,14 @@ deadline, and excess load degrades to typed shed results the caller
 can turn into HTTP 429s — the standard TPU-serving answer to the
 "compile a few buckets, keep them full" regime this subsystem
 implements (see docs/SERVING.md).
+
+The autoregressive decode path uses
+:class:`ContinuousBatchScheduler` instead: one object owning both
+stream admission (slots + page budget) and the per-step token budget
+that co-schedules chunked prefill with in-flight decode rows
+(docs/SERVING.md "Continuous batching"). ``AdmissionQueue`` and the
+budget rule of ``TokenBudgetBatcher`` are thin compat facades over
+it.
 """
 
 from __future__ import annotations
@@ -316,33 +324,52 @@ class _Queued:
     deadline: Optional[float]
 
 
-class AdmissionQueue:
-    """FIFO admission control for *streams* (decode continuous
-    batching): bounded depth, per-entry deadlines, budget-gated pops.
+class ContinuousBatchScheduler:
+    """The unified prefill+decode scheduler for the stepped decode
+    engine: one FIFO admission queue AND one per-step token-budget
+    chunk planner (docs/SERVING.md "Continuous batching").
 
-    This is ``TokenBudgetBatcher``'s budget logic recast for long-lived
-    entries: the decode engine ``offer``s each stream with its page
-    cost and, once per step, ``take``s the longest admissible prefix —
-    entries pop while slots remain and each head's cost fits the
-    remaining page budget. The head blocking preserves submission
-    order (no small-stream starvation of a large head: its pages free
-    up as running streams finish). Expired heads shed; the caller
-    resolves them with the typed ``Overloaded("deadline")`` just like
-    the micro-batcher would.
+    Admission side (long-lived entries, no worker thread, no futures
+    — the decode engine's step loop is the consumer, so every method
+    is safe to call under the engine lock): the engine ``offer``s
+    each stream with its page cost and, once per step, ``take``s the
+    longest admissible prefix — entries pop while slots remain and
+    each head's cost fits the remaining page budget. Head blocking
+    preserves submission order (no small-stream starvation of a large
+    head: its pages free up as running streams finish). Expired heads
+    shed; the caller resolves them with the typed
+    ``Overloaded("deadline")`` just like the micro-batcher would.
 
-    Unlike ``MicroBatcher`` this owns no worker thread and no futures
-    — the decode engine's step loop is the consumer — so it is safe to
-    call under the engine lock.
+    Budget side (:meth:`plan_chunks`): each step spends at most
+    ``token_budget`` tokens across ALL resident rows. In-flight
+    decode rows cost 1 each and are always scheduled — a generating
+    stream never stalls behind a new prompt. What remains is handed
+    out FIFO to prefilling rows in chunks of up to ``max_chunk``
+    prompt tokens, so waiting prompts ride the SAME stepped
+    executable as decode traffic instead of queuing behind a separate
+    prefill engine (the Sarathi/vLLM chunked-prefill discipline; the
+    r14→r17 TTFT fix). The budget is a per-step pacing target, not a
+    hard wall: the FIFO-head prefill row always advances at least one
+    token per step (the same no-livelock rule as
+    :meth:`budget_admits`'s first-entry case).
     """
 
     _GUARDED = {"_queue": "_lock"}
 
     def __init__(self, *, max_depth: int = 64,
+                 token_budget: Optional[int] = None,
+                 max_chunk: int = 1,
                  metrics: Optional[MetricsRegistry] = None,
                  clock: Callable[[], float] = time.monotonic):
         if max_depth < 1:
             raise ValueError("max_depth must be >= 1")
+        if token_budget is not None and token_budget < 1:
+            raise ValueError("token_budget must be >= 1")
+        if max_chunk < 1:
+            raise ValueError("max_chunk must be >= 1")
         self.max_depth = max_depth
+        self.token_budget = token_budget
+        self.max_chunk = int(max_chunk)
         self._clock = clock
         self._lock = threading.Lock()
         self._queue: collections.deque = collections.deque()
@@ -350,6 +377,35 @@ class AdmissionQueue:
         self._m_depth = m.gauge(
             "serving_decode_queue_depth",
             "streams waiting for slot + page admission")
+
+    # -- budget policy (pure; shared with TokenBudgetBatcher) -------------
+
+    @staticmethod
+    def budget_admits(spent: int, cost: int, budget: int) -> bool:
+        """One more entry of ``cost`` fits ``budget`` after ``spent``
+        — except the FIRST entry, which is always admitted so an
+        oversized head can never wedge the queue."""
+        return spent == 0 or spent + cost <= budget
+
+    def plan_chunks(self, decode_rows: int,
+                    prefill_remaining: Sequence[int]) -> List[int]:
+        """Split one step's token budget: returns the prompt-token
+        chunk for each prefilling row (FIFO order, aligned with
+        ``prefill_remaining``). Decode rows pre-spend ``decode_rows``
+        tokens; rows the leftover cannot reach get 0 (they idle this
+        step), except the head row, which always gets >= 1."""
+        budget = self.token_budget
+        if budget is None:
+            budget = decode_rows + len(prefill_remaining) * self.max_chunk
+        left = max(0, budget - decode_rows)
+        chunks: List[int] = []
+        for i, rem in enumerate(prefill_remaining):
+            c = min(int(rem), self.max_chunk, left)
+            if i == 0 and rem > 0:
+                c = max(c, 1)
+            chunks.append(c)
+            left = max(0, left - c)
+        return chunks
 
     @property
     def depth(self) -> int:
@@ -414,6 +470,20 @@ class AdmissionQueue:
         return items
 
 
+class AdmissionQueue(ContinuousBatchScheduler):
+    """Deprecated alias: the admission half of
+    :class:`ContinuousBatchScheduler`, kept importable so existing
+    fleet specs and ``GenerationServer`` callers keep working. New
+    code should construct ``ContinuousBatchScheduler`` directly (it
+    also owns the per-step prefill chunk budget)."""
+
+    def __init__(self, *, max_depth: int = 64,
+                 metrics: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        super().__init__(max_depth=max_depth, metrics=metrics,
+                         clock=clock)
+
+
 class TokenBudgetBatcher(MicroBatcher):
     """Continuous batching by token budget instead of request count.
 
@@ -426,6 +496,13 @@ class TokenBudgetBatcher(MicroBatcher):
     even if it alone exceeds the budget: the engine's packed-bucket
     check is the authority on servable sizes and raises the typed
     error the caller should see.
+
+    Deprecation note: the budget rule now lives on
+    :class:`ContinuousBatchScheduler` (``budget_admits``) — this
+    class is a thin facade over it that keeps the ``MicroBatcher``
+    future/worker surface for the packed single-shot serve path. The
+    decode path uses the unified scheduler directly
+    (serving/decode.py).
 
     Everything else — deadline shedding, ``drain()``, ``close()``,
     batch-failure isolation, every metric — is inherited unchanged
@@ -473,7 +550,8 @@ class TokenBudgetBatcher(MicroBatcher):
             while len(batch) < self.max_batch:
                 if self._queue:
                     cost = self.cost_fn(self._queue[0].payload)
-                    if spent + cost > self.token_budget:
+                    if not ContinuousBatchScheduler.budget_admits(
+                            spent, cost, self.token_budget):
                         break
                     batch.append(self._pop_taken_locked())
                     spent += cost

@@ -23,32 +23,41 @@ block machinery as the ragged serve path (PAPERS: "Ragged Paged
 Attention"; the stepped-executable framing follows "Compiler-First
 State Space Duality and Portable O(1) Autoregressive Caching").
 
-One step consumes one token per active stream slot and emits the
-model's prediction for the *next* position:
+One step consumes a per-row *ragged chunk* of tokens — up to
+``max_chunk`` prompt tokens for a prefilling row, exactly one for a
+decoding row, zero for an idle slot — and emits the model's
+prediction for each row's next position:
 
-1. embed ``tokens[r]`` at position ``lengths[r]``;
-2. project its K/V per kv set and scatter into the pools at
-   ``(page_tables[r, pos // page_size], pos % page_size)`` —
-   inactive slots are redirected to the reserved trash page 0;
-3. rebuild latents: ``layer_1`` + scanned ``layer_n``, each
-   cross-attending the pools through
-   :func:`~perceiver_tpu.ops.paged_attention.paged_decode_attention`;
-4. decode one query row at position ``lengths[r] + 1`` → vocab
-   logits → greedy ``next_token`` (+ top-k sidecar).
+1. embed ``tokens[r, :qlens[r]]`` at positions ``lengths[r] + j``;
+2. project each chunk token's K/V per kv set and scatter into the
+   pools at ``(page_tables[r, pos // page_size], pos % page_size)``
+   — invalid lanes are redirected to the reserved trash page 0;
+3. rebuild latents ONCE per step: ``layer_1`` + scanned ``layer_n``,
+   each cross-attending the pools through the ragged paged kernel
+   (:func:`~perceiver_tpu.ops.paged_attention.paged_decode_attention`,
+   the decode-shaped delegate of ``ragged_paged_attention``) at
+   per-row ``kv_len = lengths[r] + qlens[r]``;
+4. decode one query row at position ``lengths[r] + qlens[r]`` →
+   vocab logits → greedy ``next_token`` (+ top-k sidecar).
 
-Prefill reuses the same executable: a stream's prompt feeds through
-one token per step, so the engine owns exactly ONE compiled
-signature and token N costs the same as token 1 — the decode bench
-(``scripts/bench_decode.py``) pins that ratio and zero post-warmup
-compiles as a merge gate.
+Chunked prefill therefore reuses the same executable: a stream's
+prompt feeds through in ``max_chunk``-token slices co-scheduled with
+in-flight decode rows under one per-step token budget
+(``batcher.ContinuousBatchScheduler.plan_chunks``), so the engine
+owns exactly ONE compiled signature, token N costs the same as token
+1, and time-to-first-token collapses from one latent rebuild *per
+prompt token* to one per chunk — the decode bench
+(``scripts/bench_decode.py``) pins the O(1) ratio, a TTFT gate, and
+zero post-warmup compiles as merge gates.
 
 ``DecodeEngine`` drives the step host-side: a page allocator
-(:class:`PagePool`), continuous batching (streams join and leave
-mid-flight via ``batcher.AdmissionQueue`` — freed pages recycle with
-no fragmentation because any page serves any stream), per-stream
-token callbacks / blocking iterators, tracing (``decode_step`` /
-``token_emit`` spans), typed events (``stream_open`` /
-``stream_close``), and metrics. Shedding follows the batcher
+(:class:`PagePool`), unified continuous batching (streams join and
+leave mid-flight via ``batcher.ContinuousBatchScheduler`` — freed
+pages recycle with no fragmentation because any page serves any
+stream), per-stream token callbacks / blocking iterators, tracing
+(``prefill_chunk`` / ``decode_step`` / ``token_emit`` spans), typed
+events (``stream_open`` / ``stream_admitted`` / ``prefill_complete``
+/ ``stream_close``), and metrics. Shedding follows the batcher
 conventions: an over-capacity or expired request resolves to a typed
 :class:`~perceiver_tpu.serving.batcher.Overloaded` value; a request
 that can *never* fit the geometry raises
@@ -73,7 +82,10 @@ from perceiver_tpu.cache import aot_compile
 from perceiver_tpu.obs import events as events_mod
 from perceiver_tpu.obs import trace as trace_mod
 from perceiver_tpu.ops.policy import Policy, DEFAULT_POLICY
-from perceiver_tpu.serving.batcher import AdmissionQueue, Overloaded
+from perceiver_tpu.serving.batcher import (
+    ContinuousBatchScheduler,
+    Overloaded,
+)
 from perceiver_tpu.serving.engine import (
     RequestTooLarge,
     resolve_exec_cache,
@@ -94,6 +106,7 @@ class DecodeGeometry:
     page_size: int
     max_seq_len: int        # cap on prompt + generated (position table)
     top_k: int = 3
+    max_chunk: int = 8      # prompt tokens one prefill chunk may carry
 
     def __post_init__(self):
         if self.max_streams < 1:
@@ -109,6 +122,10 @@ class DecodeGeometry:
         if self.max_seq_len < 1:
             raise ValueError(f"max_seq_len must be >= 1, got "
                              f"{self.max_seq_len}")
+        if not 1 <= self.max_chunk <= self.max_seq_len:
+            raise ValueError(
+                f"max_chunk must be in [1, max_seq_len], got "
+                f"{self.max_chunk}")
 
     @property
     def pages_per_stream(self) -> int:
@@ -126,7 +143,7 @@ class DecodeGeometry:
     @property
     def descriptor(self) -> str:
         return (f"r{self.max_streams}_p{self.num_pages}x{self.page_size}"
-                f"_s{self.max_seq_len}")
+                f"_s{self.max_seq_len}_q{self.max_chunk}")
 
 
 class PagePool:
@@ -189,7 +206,10 @@ class PagePool:
 class DecodeGraph:
     """The decode step plus everything needed to compile and carry it.
 
-    ``fn(params, carry, tokens, active) -> (carry', outputs)``;
+    ``fn(params, carry, tokens, qlens) -> (carry', outputs)`` with
+    ``tokens (R, max_chunk) int32`` and ``qlens (R,) int32`` — row r
+    consumes its first ``qlens[r]`` token lanes this step (1 for a
+    decode row, up to ``max_chunk`` for a prefill chunk, 0 idle);
     ``carry`` is donate_argnums=(1,) — every leaf aliases an output
     (pools/lengths are updated in place, page_tables pass through),
     so the step's HBM high-water mark is ONE copy of the cache.
@@ -280,31 +300,46 @@ def build_decode_graph(model, geometry: DecodeGeometry, *,
         if hasattr(decoder.output_adapter, "num_classes") else None
     attn = (paged_decode_attention if attn_impl == "pallas"
             else paged_decode_attention_reference)
+    q_chunk = geometry.max_chunk
     # flat-gather index base for the per-stream page lookup (static)
     row_base = jnp.arange(r, dtype=jnp.int32) * pps
 
-    def fn(params, carry, tokens, active):
+    def fn(params, carry, tokens, qlens):
         enc_p = params["encoder"]
         lengths = carry["lengths"]
         tables = carry["page_tables"]
-        pos = jnp.clip(lengths, 0, max_seq - 1)
+        offs = jnp.arange(q_chunk, dtype=jnp.int32)
+        # lane j of row r lands at position lengths[r] + j; lanes past
+        # qlens[r] are dead and redirect to the trash page below
+        pos = jnp.clip(lengths[:, None] + offs[None, :],
+                       0, max_seq - 1)                       # (R, Q)
+        valid = offs[None, :] < qlens[:, None]               # (R, Q)
 
-        # 1. embed the incoming token of every slot at its position
+        # 1. embed every chunk lane at its in-stream position
         emb = encoder.input_adapter.apply_packed(
-            enc_p["input_adapter"], tokens, pos, policy=policy)  # (R, C)
+            enc_p["input_adapter"], tokens, pos,
+            policy=policy)                                   # (R, Q, C)
 
-        # 2. the O(1) cache update: scatter this token's K/V into its
-        # stream's current page; inactive slots write the trash page
-        page = jnp.take(tables.reshape(-1), row_base + pos // ps)
-        page = jax.lax.select(active, page, jnp.zeros_like(page))
-        slot = pos % ps
+        # 2. the O(chunk) cache update: scatter each lane's K/V into
+        # its stream's page walk; dead lanes write the trash page.
+        # Valid lanes never collide (positions are distinct per row,
+        # pages distinct across rows), and the trash page is never
+        # read back (reads are masked at kv_len), so duplicate dead
+        # lanes are harmless.
+        page = jnp.take(tables.reshape(-1),
+                        (row_base[:, None] + pos // ps).reshape(-1))
+        page = jax.lax.select(valid.reshape(-1), page,
+                              jnp.zeros_like(page))          # (R*Q,)
+        slot = (pos % ps).reshape(-1)
 
         def append(layer_params, kpool, vpool):
             kh, vh = cross_attention_kv(
-                layer_params["cross"]["attn"], emb[None],
-                num_heads=enc_heads, policy=policy)  # (1, R, H, Dh)
-            kpool = kpool.at[page, slot].set(kh[0].astype(kpool.dtype))
-            vpool = vpool.at[page, slot].set(vh[0].astype(vpool.dtype))
+                layer_params["cross"]["attn"], emb,
+                num_heads=enc_heads, policy=policy)  # (R, Q, H, Dh)
+            kh = kh.reshape(-1, enc_heads, head_dim)
+            vh = vh.reshape(-1, enc_heads, head_dim)
+            kpool = kpool.at[page, slot].set(kh.astype(kpool.dtype))
+            vpool = vpool.at[page, slot].set(vh.astype(vpool.dtype))
             return kpool, vpool
 
         kv = dict(carry["kv"])
@@ -312,7 +347,7 @@ def build_decode_graph(model, geometry: DecodeGeometry, *,
         if n_layers > 1:
             kv["kn"], kv["vn"] = append(enc_p["layer_n"],
                                         kv["kn"], kv["vn"])
-        new_lengths = lengths + active.astype(lengths.dtype)
+        new_lengths = lengths + qlens.astype(lengths.dtype)
 
         # 3. latents from scratch over the paged pools — mirrors
         # serving/graphs._packed_encoder_apply with the ragged kernel
@@ -396,14 +431,17 @@ class DecodeResult:
 class _Stream:
     """Engine-internal per-stream state (guarded by the engine lock)."""
 
-    __slots__ = ("sid", "prompt", "max_new", "pages_needed", "on_token",
-                 "ctx", "enqueued_at", "deadline", "slot", "pages",
-                 "fed", "next_input", "generated", "tokens_q", "done",
-                 "outcome", "error", "ttft_s", "submitted_at")
+    __slots__ = ("sid", "seq", "prompt", "max_new", "pages_needed",
+                 "on_token", "ctx", "enqueued_at", "deadline", "slot",
+                 "pages", "fed", "next_input", "generated", "tokens_q",
+                 "done", "outcome", "error", "ttft_s", "submitted_at",
+                 "prefill_chunks")
 
     def __init__(self, sid, prompt, max_new, pages_needed, on_token,
                  ctx, now, deadline):
         self.sid = sid
+        self.seq = int(sid[1:])  # admission order (FIFO chunk planning)
+        self.prefill_chunks = 0
         self.prompt = prompt
         self.max_new = max_new
         self.pages_needed = pages_needed
@@ -500,6 +538,7 @@ class DecodeEngine:
                  exec_cache=None,
                  metrics: Optional[MetricsRegistry] = None,
                  max_queue: int = 64,
+                 token_budget: Optional[int] = None,
                  auto_step: bool = True,
                  seed: int = 0):
         import jax
@@ -508,6 +547,12 @@ class DecodeEngine:
         self.task = task
         self.geometry = geometry
         self.policy = policy
+        # per-step token pacing: every decode row costs 1, the rest
+        # goes to prefill chunks — host-side policy only, never a
+        # compiled shape, so it is tunable without a recompile
+        self.token_budget = (int(token_budget) if token_budget is not None
+                             else geometry.max_streams
+                             + geometry.max_chunk)
         self.exec_cache = resolve_exec_cache(exec_cache)
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.graph = build_decode_graph(
@@ -535,11 +580,19 @@ class DecodeEngine:
         self._m_step_latency = m.histogram(
             "serving_decode_step_latency_seconds",
             "one decode step (dispatch + next_token sync)")
+        self._m_prefill_chunks = m.counter(
+            "serving_decode_prefill_chunks_total",
+            "prefill chunks executed by the unified step")
+        self._m_prefill_tokens = m.counter(
+            "serving_decode_prefill_tokens_total",
+            "prompt tokens consumed via chunked prefill")
 
         r = geometry.max_streams
         self.pool = PagePool(geometry.num_pages, geometry.page_size)
         self._m_free_pages.set(self.pool.free_pages)
-        self._queue = AdmissionQueue(max_depth=max_queue, metrics=m)
+        self._queue = ContinuousBatchScheduler(
+            max_depth=max_queue, token_budget=self.token_budget,
+            max_chunk=geometry.max_chunk, metrics=m)
         self._streams: List[Optional[_Stream]] = [None] * r
         self._tables = np.zeros((r, geometry.pages_per_stream), np.int32)
         self._lengths = np.zeros((r,), np.int32)
@@ -550,13 +603,13 @@ class DecodeEngine:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
 
-        tokens0 = jnp.zeros((r,), jnp.int32)
-        active0 = jnp.zeros((r,), jnp.bool_)
+        tokens0 = jnp.zeros((r, geometry.max_chunk), jnp.int32)
+        qlens0 = jnp.zeros((r,), jnp.int32)
         jitted = jax.jit(self.graph.fn,
                          donate_argnums=self.graph.donate_argnums)
         carry = self.graph.init_carry()
         self._exe, info = aot_compile(
-            jitted, (self.params, carry, tokens0, active0),
+            jitted, (self.params, carry, tokens0, qlens0),
             cache=self.exec_cache,
             donate_argnums=self.graph.donate_argnums,
             label=f"decode:{geometry.descriptor}",
@@ -565,9 +618,9 @@ class DecodeEngine:
             events_mod.emit("exec_cache",
                             bucket=f"decode:{geometry.descriptor}",
                             hit=bool(info["hit"]))
-        # warmup step with every slot inactive: the steady state then
+        # warmup step with every slot idle: the steady state then
         # re-runs an already-warm executable — zero per-step compiles
-        carry, out = self._exe(self.params, carry, tokens0, active0)
+        carry, out = self._exe(self.params, carry, tokens0, qlens0)
         np.asarray(out["next_token"])
         self._carry = carry
 
@@ -661,15 +714,19 @@ class DecodeEngine:
                 stream.ctx.record("queue_wait", start=stream.enqueued_at,
                                   end=now, stream=stream.sid)
             events_mod.emit("stream_open", stream=stream.sid)
+            events_mod.emit("stream_admitted", stream=stream.sid,
+                            pages=len(stream.pages))
             self._m_active.set(
                 sum(1 for s in self._streams if s is not None))
             self._m_free_pages.set(self.pool.free_pages)
 
     def step(self) -> int:
-        """Run one decode step over every occupied slot (admitting
-        queued streams first). Returns the number of active streams
-        stepped — 0 means idle. Emits/finishes streams as a side
-        effect; callbacks fire outside the engine lock."""
+        """Run one unified step over every occupied slot (admitting
+        queued streams first): decode rows consume their fed-back
+        token, prefilling rows consume a budget-planned prompt chunk
+        — one executable, one dispatch. Returns the number of active
+        streams stepped — 0 means idle. Emits/finishes streams as a
+        side effect; callbacks fire outside the engine lock."""
         import jax.numpy as jnp
 
         emits: List[tuple] = []
@@ -684,11 +741,25 @@ class DecodeEngine:
             if not live:
                 return 0
             r = self.geometry.max_streams
-            tokens = np.zeros((r,), np.int32)
-            active = np.zeros((r,), bool)
-            for i, s in live:
-                tokens[i] = s.next_input
-                active[i] = True
+            decode_live = [(i, s) for i, s in live
+                           if s.fed >= len(s.prompt)]
+            prefill_live = sorted(
+                ((i, s) for i, s in live if s.fed < len(s.prompt)),
+                key=lambda e: e[1].seq)  # FIFO by admission order
+            plan = self._queue.plan_chunks(
+                len(decode_live),
+                [len(s.prompt) - s.fed for _, s in prefill_live])
+            tokens = np.zeros((r, self.geometry.max_chunk), np.int32)
+            qlens = np.zeros((r,), np.int32)
+            for i, s in decode_live:
+                tokens[i, 0] = s.next_input
+                qlens[i] = 1
+            chunks: Dict[int, int] = {}
+            for (i, s), c in zip(prefill_live, plan):
+                chunks[i] = c
+                if c > 0:
+                    tokens[i, :c] = s.prompt[s.fed:s.fed + c]
+                    qlens[i] = c
             carry = self._carry
             self._carry = None  # donated: loud failure on re-entry
             if self._dirty:
@@ -698,7 +769,7 @@ class DecodeEngine:
             try:
                 carry, out = self._exe(self.params, carry,
                                        jnp.asarray(tokens),
-                                       jnp.asarray(active))
+                                       jnp.asarray(qlens))
                 # the one deliberate sync of the decode path
                 next_tok = np.asarray(out["next_token"])
             except Exception as e:
@@ -706,17 +777,34 @@ class DecodeEngine:
                 raise
             t1 = time.monotonic()
             self._carry = carry
-            self._lengths[active] += 1
+            self._lengths += qlens
             self._m_steps.inc()
             self._m_step_latency.observe(t1 - t0)
             for i, s in live:
-                if s.ctx is not None:
-                    s.ctx.record("decode_step", start=t0, end=t1,
-                                 stream=s.sid)
-                s.fed += 1
-                if s.fed < len(s.prompt):
-                    s.next_input = int(s.prompt[s.fed])
-                    continue
+                was_prefill = s.fed < len(s.prompt)
+                if was_prefill:
+                    c = chunks.get(i, 0)
+                    if c == 0:
+                        continue  # budget-starved this step; keep FIFO
+                    s.fed += c
+                    s.prefill_chunks += 1
+                    self._m_prefill_chunks.inc()
+                    self._m_prefill_tokens.inc(c)
+                    if s.ctx is not None:
+                        s.ctx.record("prefill_chunk", start=t0, end=t1,
+                                     stream=s.sid, chunk=c, fed=s.fed)
+                    if s.fed < len(s.prompt):
+                        continue
+                    # the chunk that consumed the last prompt token
+                    # already produced the first generated token below
+                    events_mod.emit("prefill_complete", stream=s.sid,
+                                    prompt_tokens=len(s.prompt),
+                                    chunks=s.prefill_chunks)
+                else:
+                    s.fed += 1
+                    if s.ctx is not None:
+                        s.ctx.record("decode_step", start=t0, end=t1,
+                                     stream=s.sid)
                 tok = int(next_tok[i])
                 s.generated.append(tok)
                 s.next_input = tok

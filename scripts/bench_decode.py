@@ -1,31 +1,44 @@
 #!/usr/bin/env python
-"""Streaming-decode load generator: the O(1) paged-KV merge gate.
+"""Streaming-decode load generator: the O(1) paged-KV + TTFT gates.
 
 Drives a ``DecodeEngine`` with a churning open-loop workload — streams
 with varied lengths join and leave mid-flight, so the engine's slot
-occupancy, page allocation, and admission queue all cycle while the
-ONE stepped executable keeps replaying. Emits a ``bench.py``-format
-result line::
+occupancy, page allocation, and unified prefill+decode scheduler all
+cycle while the ONE stepped executable keeps replaying. Emits a
+``bench.py``-format result line::
 
     {"metric": "decode_tokens_per_sec", "value": ..., "unit":
      "tokens/s", "vs_baseline": null, "detail": {"p50_ms": ...,
-     "ttft_p50_ms": ..., "o1_ratio": ..., ...}}
+     "ttft_p50_ms": ..., "o1_ratio": ..., "phase_breakdown_ms": ...}}
 
-Two hard gates, each an ``exit 1``:
+Three hard gates, each an ``exit 1``:
 
 - **O(1) per-token cost** — the p95 inter-token gap at each stream's
   LAST token must stay within ``--gate-ratio`` (default 1.15×) of the
   p95 gap at token 10. Paged attention reads the same page-table-bound
   footprint at every position; any per-position growth (quadratic
   recompute, cache copies) shows up here.
+- **TTFT** — p95 time-to-first-token must stay within
+  ``--ttft-gate-ratio`` (default 10×) of the p95 inter-token gap.
+  Chunked prefill feeds up to ``--max-chunk`` prompt tokens per step
+  co-scheduled with decode traffic, so a prompt costs
+  ``ceil(len/chunk)`` steps, not ``len`` steps behind a convoy (the
+  r14 regression: 1031 ms TTFT ≈ 150× the 6.7 ms token gap).
 - **Zero post-warmup XLA compiles** (``jax.monitoring``) — streams
-  joining/leaving must never change the step signature; a mid-traffic
-  compile is a geometry-bucketing bug.
+  joining/leaving, prefill chunks, and decode rows all share one step
+  signature; a mid-traffic compile is a geometry-bucketing bug.
+
+The TTFT phase breakdown is derived from the request trace spans
+(``obs/trace.py``): per stream, ``queue_wait`` (admission), the
+``prefill_chunk`` steps before the one that completed the prompt, and
+``first_decode`` (the step that consumed the last chunk and emitted
+token 0) — the same ``phase_breakdown_ms`` shape bench_serving emits.
 
 Runs on any backend; on CPU use ``--preset tiny`` (the default), which
 decodes a test-sized model — the point of the CPU run is the gate
-pair, not throughput. On a chip, drop ``--preset tiny`` for the
-canonical MLM shapes (the ``decode_mlm_r8_p64x16`` target geometry).
+trio, not throughput. On a chip, drop ``--preset tiny`` for the
+canonical MLM shapes (the ``decode_mixed_mlm_r8_p64x16_q8`` target
+geometry scaled to the offered concurrency).
 
 Examples::
 
@@ -40,6 +53,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import math
 import os
 import sys
 import time
@@ -90,9 +104,44 @@ def _pct(values, q):
     return float(np.percentile(np.asarray(values, np.float64), q))
 
 
-def main() -> int:
+def _ttft_phases(spans):
+    """Split one stream's trace into the TTFT phases (ms).
+
+    ``first_decode`` is the step span that emitted token 0 — by the
+    engine's emission rule that is the ``prefill_chunk`` which consumed
+    the last prompt slice (or a ``decode_step``, defensively).
+    ``prefill_chunks`` sums the chunk steps before it, ``queue_wait``
+    is the admission span. Returns a dict of phase -> ms (phases with
+    no span are absent).
+    """
+    emits = sorted((s for s in spans if s["phase"] == "token_emit"),
+                   key=lambda s: s["end"])
+    if not emits:
+        return {}
+    first_emit = emits[0]["end"]
+    out = {}
+    waits = [s for s in spans if s["phase"] == "queue_wait"]
+    if waits:
+        out["queue_wait"] = 1e3 * sum(s["duration_s"] for s in waits)
+    steps = [s for s in spans
+             if s["phase"] in ("prefill_chunk", "decode_step")
+             and s["end"] <= first_emit]
+    if steps:
+        steps.sort(key=lambda s: s["end"])
+        out["first_decode"] = 1e3 * steps[-1]["duration_s"]
+        chunks = [s for s in steps[:-1] if s["phase"] == "prefill_chunk"]
+        if chunks:
+            out["prefill_chunks"] = 1e3 * sum(s["duration_s"]
+                                              for s in chunks)
+    return out
+
+
+def run(argv=None):
+    """The bench body: returns ``(exit_code, result_dict)`` so tests
+    can drive it in-process; ``main`` wraps it for the CLI."""
     ap = argparse.ArgumentParser(
-        description="streaming decode bench: O(1) paged-KV gate")
+        description="streaming decode bench: O(1) paged-KV + TTFT "
+                    "gates")
     ap.add_argument("--preset", choices=("tiny", "full"),
                     default="tiny",
                     help="tiny = CPU-sized model (default); full = "
@@ -102,32 +151,54 @@ def main() -> int:
     ap.add_argument("--max-new-min", type=int, default=40)
     ap.add_argument("--max-new-max", type=int, default=120)
     ap.add_argument("--prompt-len", type=int, default=8)
+    ap.add_argument("--max-chunk", type=int, default=8,
+                    help="prefill chunk lanes in the unified step "
+                         "(default 8)")
+    ap.add_argument("--token-budget", type=int, default=0,
+                    help="per-step token budget for the scheduler; "
+                         "0 = engine default (slots + max_chunk)")
     ap.add_argument("--gate-ratio", type=float, default=1.15,
                     help="p95(last token) must be <= ratio * "
                          "p95(token 10)")
+    ap.add_argument("--ttft-gate-ratio", type=float, default=10.0,
+                    help="ttft_p95 must be <= ratio * p95 inter-token "
+                         "gap")
     ap.add_argument("--gate-token", type=int, default=10,
                     help="early token index the gate compares against")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None,
                     help="also write the result JSON to this path")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
+    from perceiver_tpu.obs import trace as trace_mod
     from perceiver_tpu.serving.decode import DecodeEngine, DecodeGeometry
 
     if args.max_new_min <= args.gate_token:
         ap.error("--max-new-min must exceed --gate-token so every "
                  "stream contributes an early-token sample")
 
+    # continuous batching sizes the slot axis to the offered
+    # concurrency (capped), so admission never convoys behind a
+    # fixed 8-slot pool — the other half of the r14 TTFT fix
+    page_size = 16
+    slots = max(1, min(args.streams, 32))
     max_seq = args.prompt_len + args.max_new_max
+    pages_per = math.ceil(max_seq / page_size)
+    num_pages = slots * pages_per + 1
     if args.preset == "tiny":
         task = _tiny_decode_task(max_seq)
-        geometry = DecodeGeometry(max_streams=8, num_pages=81,
-                                  page_size=16, max_seq_len=max_seq)
+        geometry = DecodeGeometry(max_streams=slots,
+                                  num_pages=num_pages,
+                                  page_size=page_size,
+                                  max_seq_len=max_seq,
+                                  max_chunk=args.max_chunk)
     else:
         task = _full_decode_task(max(512, max_seq))
-        geometry = DecodeGeometry(max_streams=8, num_pages=81,
-                                  page_size=16,
-                                  max_seq_len=max(512, max_seq))
+        geometry = DecodeGeometry(max_streams=slots,
+                                  num_pages=num_pages,
+                                  page_size=page_size,
+                                  max_seq_len=max(512, max_seq),
+                                  max_chunk=args.max_chunk)
 
     rng = np.random.default_rng(args.seed)
     vocab = task.vocab_size
@@ -138,8 +209,10 @@ def main() -> int:
     ]
 
     t_build = time.monotonic()
-    engine = DecodeEngine(task, geometry=geometry, auto_step=True,
-                          max_queue=args.streams + 1)
+    engine = DecodeEngine(
+        task, geometry=geometry, auto_step=True,
+        max_queue=args.streams + 1,
+        token_budget=args.token_budget or None)
     print(f"[bench_decode] engine up in "
           f"{time.monotonic() - t_build:.1f}s — geometry "
           f"{geometry.descriptor}", flush=True)
@@ -152,20 +225,36 @@ def main() -> int:
             emit_times[i].append(time.monotonic())
         return on_token
 
-    t0 = time.monotonic()
-    with _compile_events() as compiles:
-        handles = []
-        for i, (prompt, max_new) in enumerate(plans):
-            # stagger arrivals: a fresh stream joins roughly every
-            # half-stream lifetime, so slots churn (join/leave
-            # mid-flight) instead of running in lockstep waves
-            handles.append(engine.submit(prompt,
-                                         max_new_tokens=max_new,
-                                         on_token=tracker(i)))
-            time.sleep(0.01)
-        results = [h.result(timeout=600.0) for h in handles]
-    wall = time.monotonic() - t0
-    engine.close()
+    # a trace buffer big enough that no stream's early spans evict
+    # (queue_wait + every prefill chunk + the first emit must survive)
+    buf = trace_mod.TraceBuffer(
+        max_traces=args.streams + 8,
+        max_spans_per_trace=4 * (max_seq + 4))
+    prev_buf = trace_mod.set_default_buffer(buf)
+    try:
+        t0 = time.monotonic()
+        with _compile_events() as compiles:
+            handles = []
+            for i, (prompt, max_new) in enumerate(plans):
+                # stagger arrivals so slots churn (join/leave
+                # mid-flight) instead of running in lockstep waves
+                handles.append(engine.submit(prompt,
+                                             max_new_tokens=max_new,
+                                             on_token=tracker(i)))
+                time.sleep(0.01)
+            results = [h.result(timeout=600.0) for h in handles]
+        wall = time.monotonic() - t0
+        engine.close()
+
+        phase_ms = {}
+        for h in handles:
+            if h.trace_ctx is None:
+                continue
+            spans = buf.get(h.trace_ctx.trace_id) or []
+            for phase, ms in _ttft_phases(spans).items():
+                phase_ms.setdefault(phase, []).append(ms)
+    finally:
+        trace_mod.set_default_buffer(prev_buf)
 
     total_tokens = sum(len(r.tokens) for r in results)
     for (prompt, max_new), r in zip(plans, results):
@@ -184,8 +273,12 @@ def main() -> int:
 
     p95_early = _pct(early_ms, 95)
     p95_last = _pct(last_ms, 95)
+    p95_gap = _pct(gaps_ms, 95)
+    ttft_p95 = _pct(ttft_ms, 95)
     o1_ratio = p95_last / p95_early
+    ttft_ratio = ttft_p95 / p95_gap
     gate_ok = o1_ratio <= args.gate_ratio
+    ttft_ok = ttft_ratio <= args.ttft_gate_ratio
     compiles_ok = len(compiles) == 0
 
     import jax
@@ -200,14 +293,24 @@ def main() -> int:
             "geometry": geometry.descriptor,
             "streams": args.streams,
             "prompt_len": args.prompt_len,
+            "max_chunk": args.max_chunk,
+            "token_budget": args.token_budget or None,
             "max_new_range": [args.max_new_min, args.max_new_max],
             "total_tokens": total_tokens,
             "wall_s": round(wall, 2),
             "p50_ms": round(_pct(gaps_ms, 50), 3),
-            "p95_ms": round(_pct(gaps_ms, 95), 3),
+            "p95_ms": round(p95_gap, 3),
             "p99_ms": round(_pct(gaps_ms, 99), 3),
             "ttft_p50_ms": round(_pct(ttft_ms, 50), 3),
-            "ttft_p95_ms": round(_pct(ttft_ms, 95), 3),
+            "ttft_p95_ms": round(ttft_p95, 3),
+            "ttft_ratio": round(ttft_ratio, 4),
+            "ttft_gate": args.ttft_gate_ratio,
+            "phase_breakdown_ms": {
+                phase: {"p50": round(_pct(values, 50), 3),
+                        "p95": round(_pct(values, 95), 3),
+                        "spans": len(values)}
+                for phase, values in sorted(phase_ms.items())
+            },
             f"p95_token{args.gate_token}_ms": round(p95_early, 3),
             "p95_last_token_ms": round(p95_last, 3),
             "o1_ratio": round(o1_ratio, 4),
@@ -231,7 +334,18 @@ def main() -> int:
               f"{p95_last:.3f}ms > {args.gate_ratio}x p95 at token "
               f"{args.gate_token} ({p95_early:.3f}ms) — per-token cost "
               f"is growing with position", file=sys.stderr)
-    return 0 if (gate_ok and compiles_ok) else 1
+    if not ttft_ok:
+        print(f"[bench_decode] FAIL: ttft p95 {ttft_p95:.3f}ms > "
+              f"{args.ttft_gate_ratio}x p95 token gap "
+              f"({p95_gap:.3f}ms) — prefill is convoying behind "
+              f"decode traffic again", file=sys.stderr)
+    code = 0 if (gate_ok and ttft_ok and compiles_ok) else 1
+    return code, result
+
+
+def main(argv=None) -> int:
+    code, _ = run(argv)
+    return code
 
 
 if __name__ == "__main__":

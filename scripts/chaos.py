@@ -1088,6 +1088,127 @@ def scenario_race_admission(tmp: str) -> dict:
             "faults_fired": {"race.interleave": len(seeds)}}
 
 
+def scenario_race_mixed_prefill(tmp: str) -> dict:
+    """The unified prefill+decode scheduler
+    (``serving.batcher.ContinuousBatchScheduler``) under adversarial
+    seeded interleavings: producers offer streams while the step-loop
+    consumer alternates ``take`` (slot+page admission) with
+    ``plan_chunks`` over the rows it owns — the mixed-phase hot path
+    of the chunked-prefill decode engine. Asserts conservation (every
+    offered stream admitted, shed, rejected, or left queued exactly
+    once), the per-step budget invariant (non-head prefill chunks
+    never exceed the leftover budget after decode rows; the FIFO head
+    always advances >= 1 token; no chunk exceeds ``max_chunk`` or the
+    remaining prompt), completion (every admitted prompt prefills to
+    zero remaining and then decodes), and seed-deterministic replay
+    (the racecheck runtime-harness contract)."""
+    import itertools
+
+    from perceiver_tpu.serving.batcher import ContinuousBatchScheduler
+    from perceiver_tpu.utils.concurrency import (
+        InstrumentedLock,
+        InterleaveScheduler,
+        guarded,
+    )
+
+    BUDGET, MAX_CHUNK = 4, 3
+
+    def run_once(seed: int):
+        sched = InterleaveScheduler(seed=seed)
+        ticks = itertools.count()
+        q = ContinuousBatchScheduler(max_depth=8, token_budget=BUDGET,
+                                     max_chunk=MAX_CHUNK,
+                                     clock=lambda: next(ticks) * 1e-3)
+        lock = InstrumentedLock(sched, name="scheduler._lock")
+        q._lock = lock
+        q._queue = guarded(q._queue, lock, label="scheduler deque")
+
+        offered, rejected = [], []
+        admitted, shed = [], []
+        # consumer-owned mixed-phase state: item -> remaining prompt
+        prefill, decoding = {}, {}
+        planned_steps = [0]
+
+        def producer(base: int):
+            def run():
+                for i in range(6):
+                    item = f"s{base}-{i}"
+                    deadline = 0.0 if i % 3 == 2 else None
+                    if q.offer(item, cost=1 + (i % 3),
+                               deadline=deadline):
+                        offered.append(item)
+                    else:
+                        rejected.append(item)
+            return run
+
+        def consumer():
+            for _ in range(64):
+                a, s = q.take(budget=4, slots=3 - len(prefill)
+                              - len(decoding))
+                admitted.extend(a)
+                shed.extend(s)
+                for item in a:
+                    # deterministic prompt length from the stream id
+                    prefill[item] = 2 + (int(item[-1]) % 4)
+                order = sorted(prefill)  # FIFO by id (deterministic)
+                rems = [prefill[i] for i in order]
+                plan = q.plan_chunks(len(decoding), rems)
+                planned_steps[0] += 1
+                # --- the budget invariant, asserted EVERY step ---
+                left = max(0, BUDGET - len(decoding))
+                assert all(c <= MAX_CHUNK for c in plan), plan
+                assert all(c <= r for c, r in zip(plan, rems)), plan
+                assert sum(plan[1:]) <= left, (plan, left)
+                assert sum(plan) <= left + 1, (plan, left)
+                if rems:
+                    assert plan[0] >= 1, plan  # head anti-starvation
+                for item, c in zip(order, plan):
+                    prefill[item] -= c
+                    if prefill[item] == 0:
+                        del prefill[item]
+                        decoding[item] = 2  # decode a couple of steps
+                for item in [d for d, n in decoding.items() if n == 0]:
+                    del decoding[item]
+                for item in decoding:
+                    decoding[item] -= 1
+                if (len(offered) + len(rejected) == 12
+                        and q.depth == 0 and not prefill
+                        and not decoding):
+                    return
+
+        sched.spawn(producer(0), name="producer-0")
+        sched.spawn(producer(1), name="producer-1")
+        sched.spawn(consumer, name="step-loop")
+        sched.run()
+        leftover = q.drain_all()
+        assert not prefill, f"prompts stuck mid-prefill: {prefill}"
+        return (tuple(admitted), tuple(shed), tuple(rejected),
+                tuple(leftover), planned_steps[0],
+                tuple(sched.trace))
+
+    seeds = [3, 11, 4321]
+    totals = {"admitted": 0, "shed": 0, "rejected": 0, "leftover": 0,
+              "planned_steps": 0}
+    for seed in seeds:
+        first = run_once(seed)
+        admitted, shed, rejected, leftover, steps, _trace = first
+        everything = list(admitted) + list(shed) + list(rejected) \
+            + list(leftover)
+        expect = {f"s{b}-{i}" for b in (0, 1) for i in range(6)}
+        assert sorted(everything) == sorted(expect), (
+            f"seed {seed}: streams lost or duplicated: {everything}")
+        assert run_once(seed) == first, f"seed {seed} not deterministic"
+        totals["admitted"] += len(admitted)
+        totals["shed"] += len(shed)
+        totals["rejected"] += len(rejected)
+        totals["leftover"] += len(leftover)
+        totals["planned_steps"] += steps
+    return {"seeds": seeds, "streams_per_seed": 12,
+            "token_budget": BUDGET, "max_chunk": MAX_CHUNK,
+            "deterministic_replays": len(seeds), **totals,
+            "faults_fired": {"race.interleave": len(seeds)}}
+
+
 # scenario name -> (fault plan armed via PERCEIVER_FAULTS, fn)
 _SCENARIOS = {
     "loader_crash": ("loader.exception@at=1,count=2",
@@ -1100,9 +1221,10 @@ _SCENARIOS = {
     "preempt": ("train.preempt@at=3", scenario_preempt),
     "serve_dispatch": ("serve.dispatch@at=1,count=4",
                        scenario_serve_dispatch),
-    # race_admission arms no fault plan: the "fault" is the adversarial
-    # thread interleaving itself (racecheck runtime harness)
+    # race_* arm no fault plan: the "fault" is the adversarial thread
+    # interleaving itself (racecheck runtime harness)
     "race_admission": (None, scenario_race_admission),
+    "race_mixed_prefill": (None, scenario_race_mixed_prefill),
     # fleet scenarios arm faults per-REPLICA (supervisor env overrides)
     # rather than in the scenario child, so the plan column stays None
     "fleet_kill_replica": (None, scenario_fleet_kill_replica),
@@ -1117,8 +1239,10 @@ _SCENARIOS = {
     "dist_cutover_kill": (None, scenario_dist_cutover_kill),
 }
 _MATRIX = ["loader_crash", "nan_skip", "nan_rewind", "truncated_ckpt",
-           "kill_save", "preempt", "serve_dispatch", "race_admission"]
-_FAST = ["nan_skip", "serve_dispatch", "race_admission"]
+           "kill_save", "preempt", "serve_dispatch", "race_admission",
+           "race_mixed_prefill"]
+_FAST = ["nan_skip", "serve_dispatch", "race_admission",
+         "race_mixed_prefill"]
 _FLEET_MATRIX = ["fleet_kill_replica", "fleet_stall",
                  "fleet_rollout_corrupt", "fleet_rollout"]
 _FLEET_FAST = ["fleet_kill_replica"]

@@ -1,0 +1,82 @@
+"""bench_decode runner: the TTFT + O(1) gate pair drive exit codes
+(scripts/bench_decode.py, docs/BENCHMARKING.md round 17).
+
+The bench is run IN-PROCESS at test-sized load so its result dict and
+gate decisions are directly assertable — the clean run must exit 0
+with the span-derived TTFT phase breakdown populated, and each gate
+must trip (exit 1) when seeded with an absurd threshold. A bench
+whose gates cannot fail is not a merge gate.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_decode_under_test",
+        os.path.join(_ROOT, "scripts", "bench_decode.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# at 4 tiny streams a step is ~1 ms, so scheduler jitter swamps the
+# production 1.15x O(1) ratio — the in-process runs relax it (the
+# seeded-violation test still proves the gate can trip)
+_FAST_ARGS = ["--streams", "4", "--max-new-min", "12",
+              "--max-new-max", "16", "--prompt-len", "6",
+              "--max-chunk", "4", "--seed", "3", "--gate-ratio", "4.0"]
+
+
+@pytest.fixture(scope="module")
+def clean_run(bench):
+    """One real tiny bench run shared by the assertions below (the
+    engine build + decode dominates the cost; run it once)."""
+    return bench.run(_FAST_ARGS)
+
+
+def test_bench_decode_clean_run_passes_gates(clean_run):
+    code, result = clean_run
+    assert code == 0
+    d = result["detail"]
+    assert result["metric"] == "decode_tokens_per_sec"
+    assert d["post_warmup_compiles"] == 0
+    assert d["o1_ratio"] <= d["o1_gate"]
+    assert d["ttft_ratio"] <= d["ttft_gate"]
+    # geometry scaled to offered concurrency, chunk lanes in the key
+    assert d["geometry"].startswith("r4_") and d["geometry"].endswith(
+        "_q4")
+
+
+def test_bench_decode_phase_breakdown_is_span_derived(clean_run):
+    _, result = clean_run
+    phases = result["detail"]["phase_breakdown_ms"]
+    # every stream contributes a queue_wait and a first_decode span;
+    # prompt 6 over chunk 4 takes 2 chunks, so the first one lands in
+    # prefill_chunks and the completing one IS first_decode
+    for phase in ("queue_wait", "prefill_chunks", "first_decode"):
+        assert phase in phases, phases
+        assert phases[phase]["spans"] == 4
+        assert phases[phase]["p95"] >= phases[phase]["p50"] >= 0.0
+
+
+def test_bench_decode_seeded_ttft_violation_exits_nonzero(bench):
+    """An impossible TTFT gate must flip the exit code — TTFT always
+    spans >= 1 full step, so a sub-1x ratio cannot pass."""
+    code, result = bench.run(_FAST_ARGS + ["--ttft-gate-ratio", "0.01"])
+    assert code == 1
+    assert result["detail"]["ttft_ratio"] > 0.01
+
+
+def test_bench_decode_seeded_o1_violation_exits_nonzero(bench):
+    """Same for the O(1) gate: a near-zero allowed growth ratio trips
+    on any real run."""
+    code, result = bench.run(_FAST_ARGS + ["--gate-ratio", "0.0001"])
+    assert code == 1
+    assert result["detail"]["o1_ratio"] > 0.0001

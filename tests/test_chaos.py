@@ -31,6 +31,12 @@ def test_chaos_fast_matrix_survives():
     assert all(ln["value"] == 1.0 for ln in scenarios)
     # the faults really fired (survival by inertness doesn't count)
     assert all(ln["detail"]["faults_fired"] for ln in scenarios)
+    # the unified-scheduler interleaving scenario (ISSUE 17) rode the
+    # fast tier: mixed prefill/decode admission under seeded schedules
+    # with every step's budget invariant asserted and replays bitwise
+    mixed = by_metric["chaos_race_mixed_prefill"]["detail"]
+    assert mixed["deterministic_replays"] == len(mixed["seeds"])
+    assert mixed["admitted"] > 0 and mixed["planned_steps"] > 0
 
 
 def test_chaos_fleet_fast_survives():

@@ -30,7 +30,12 @@ import jax.numpy as jnp
 from perceiver_tpu.obs import events as events_mod
 from perceiver_tpu.obs.events import EventLog
 from perceiver_tpu.ops.policy import Policy
-from perceiver_tpu.serving.batcher import AdmissionQueue, Overloaded
+from perceiver_tpu.serving.batcher import (
+    AdmissionQueue,
+    ContinuousBatchScheduler,
+    Overloaded,
+    TokenBudgetBatcher,
+)
 from perceiver_tpu.serving.decode import (
     DecodeEngine,
     DecodeGeometry,
@@ -182,6 +187,63 @@ def test_admission_queue_remove_and_drain():
     assert q.depth == 0
 
 
+# --- ContinuousBatchScheduler: unified budget policy -------------------------
+
+
+def test_scheduler_plan_chunks_budget_math():
+    s = ContinuousBatchScheduler(token_budget=8, max_chunk=4)
+    # no prefill rows: nothing to plan
+    assert s.plan_chunks(3, []) == []
+    # decode rows pre-spend 1 each; leftover goes FIFO in max_chunk bites
+    assert s.plan_chunks(2, [10, 10, 10]) == [4, 2, 0]
+    # fully decode-saturated step: the head prefill row STILL advances
+    # one token (anti-starvation) while the rest idle
+    assert s.plan_chunks(8, [10, 10]) == [1, 0]
+    # a chunk never exceeds the remaining prompt
+    assert s.plan_chunks(0, [3, 10]) == [3, 4]
+    # no budget configured -> every prefill row gets a full chunk
+    unlimited = ContinuousBatchScheduler(max_chunk=4)
+    assert unlimited.plan_chunks(5, [10, 2]) == [4, 2]
+
+
+def test_scheduler_budget_admits_head_rule():
+    admits = ContinuousBatchScheduler.budget_admits
+    assert admits(0, 999, 8)  # first entry always fits (no wedged head)
+    assert admits(3, 5, 8)
+    assert not admits(3, 6, 8)
+
+
+def test_scheduler_validation():
+    with pytest.raises(ValueError, match="token_budget"):
+        ContinuousBatchScheduler(token_budget=0)
+    with pytest.raises(ValueError, match="max_chunk"):
+        ContinuousBatchScheduler(max_chunk=0)
+
+
+def test_admission_queue_and_token_batcher_are_compat_facades():
+    """Satellite: the legacy names keep importing and behaving, as thin
+    facades over the unified scheduler."""
+    assert issubclass(AdmissionQueue, ContinuousBatchScheduler)
+    q = AdmissionQueue(max_depth=2)
+    assert q.token_budget is None and q.max_chunk == 1
+    assert "eprecated" in AdmissionQueue.__doc__
+    assert "eprecation" in TokenBudgetBatcher.__doc__
+    # the packed batcher's budget rule IS the scheduler's static rule
+    done = threading.Event()
+
+    def runner(payloads):
+        done.set()
+        return [0] * len(payloads)
+
+    tb = TokenBudgetBatcher(runner, token_budget=4, cost_fn=len)
+    try:
+        fut = tb.submit([1] * 9)  # oversized head still admits
+        assert fut.result(timeout=2.0) == 0
+        assert done.is_set()
+    finally:
+        tb.close(timeout=2.0)
+
+
 # --- geometry ---------------------------------------------------------------
 
 
@@ -255,6 +317,109 @@ def test_paged_decode_matches_full_recompute(policy_name):
         _idle(eng)
     finally:
         eng.close(timeout=2.0)
+
+
+@pytest.mark.parametrize("policy_name", ["fp32", "bf16"])
+def test_chunked_prefill_parity_across_chunk_sizes(policy_name):
+    """Token-exact parity of chunked prefill: the SAME prompt split
+    into chunks of 1 (pure stepwise), 4 (mid, uneven final chunk), and
+    >= prompt_len (one-shot prefill) generates identical tokens, each
+    equal to the full-recompute oracle — the ragged kernel's causal
+    cache writes are position-exact regardless of how the prompt was
+    sliced across steps."""
+    policy = getattr(Policy, policy_name)()
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, VOCAB, size=9).astype(np.int32)
+    ref = None
+    outs = {}
+    for chunk in (1, 4, 9):
+        eng = DecodeEngine(small_task(),
+                           geometry=small_geometry(max_chunk=chunk),
+                           policy=policy, auto_step=False,
+                           exec_cache=False)
+        try:
+            h = eng.submit(prompt, max_new_tokens=5)
+            eng.run_until_idle()
+            got = h.result(timeout=1.0)
+            assert isinstance(got, DecodeResult)
+            outs[chunk] = got.tokens
+            if ref is None:  # params are seed-deterministic across engines
+                ref = _reference_generate(eng.graph.model, eng.params,
+                                          policy, prompt, 5)
+            _idle(eng)
+        finally:
+            eng.close(timeout=2.0)
+    for chunk, toks in outs.items():
+        assert toks == ref, (
+            f"{policy_name} max_chunk={chunk} diverged: chunked "
+            f"{toks} vs full-recompute {ref}")
+
+
+def test_chunked_prefill_spans_events_and_metrics():
+    """A 9-token prompt through max_chunk=4 prefills in exactly 3
+    steps (4+4+1); the completing step emits the first token. The obs
+    plane must show it: 3 ``prefill_chunk`` spans with those chunk
+    sizes, one ``stream_admitted`` and one ``prefill_complete`` event,
+    and the prefill counters advanced."""
+    prev = events_mod.set_default_log(EventLog())
+    eng = DecodeEngine(small_task(), geometry=small_geometry(max_chunk=4),
+                       policy=Policy.fp32(), auto_step=False,
+                       exec_cache=False)
+    try:
+        prompt = (np.arange(9, dtype=np.int32) * 13 + 1) % VOCAB
+        h = eng.submit(prompt, max_new_tokens=3)
+        eng.run_until_idle()
+        assert isinstance(h.result(1.0), DecodeResult)
+        log = events_mod.default_log()
+        assert [e["stream"] for e in log.events("stream_admitted")] == [
+            h.stream_id]
+        done = log.events("prefill_complete")
+        assert [(e["stream"], e["prompt_tokens"], e["chunks"])
+                for e in done] == [(h.stream_id, 9, 3)]
+        from perceiver_tpu.obs import trace as trace_mod
+        spans = trace_mod.default_buffer().get(h.trace_ctx.trace_id)
+        pf = [s for s in spans if s["phase"] == "prefill_chunk"]
+        assert [s["attrs"]["chunk"] for s in pf] == [4, 4, 1]
+        assert [s["attrs"]["fed"] for s in pf] == [4, 8, 9]
+        emits = [s for s in spans if s["phase"] == "token_emit"]
+        assert len(emits) == 3
+        # first token came out of the completing prefill step, not a
+        # later decode-only step: its span end == last chunk's end
+        assert emits[0]["end"] == pf[-1]["end"]
+        text = eng.metrics_text()
+        assert "serving_decode_prefill_chunks_total 3" in text
+        assert "serving_decode_prefill_tokens_total 9" in text
+        _idle(eng)
+    finally:
+        eng.close(timeout=2.0)
+        events_mod.set_default_log(prev)
+
+
+def test_token_budget_paces_prefill_but_never_decode():
+    """With token_budget=2 and one stream already decoding, a new
+    prompt prefills at 1 token/step (head-row minimum) while the
+    decoding stream keeps emitting every step — decode rows are never
+    stalled behind prefill."""
+    prev = events_mod.set_default_log(EventLog())
+    eng = DecodeEngine(small_task(), geometry=small_geometry(max_chunk=4),
+                       policy=Policy.fp32(), auto_step=False,
+                       exec_cache=False, token_budget=2)
+    try:
+        a = eng.submit(np.asarray([5, 6], np.int32), max_new_tokens=12)
+        eng.step()  # a prefills (2 tokens, budget head-min covers it)
+        b = eng.submit(np.asarray([7] * 8, np.int32), max_new_tokens=2)
+        eng.run_until_idle()
+        ra, rb = a.result(1.0), b.result(1.0)
+        assert isinstance(ra, DecodeResult) and len(ra.tokens) == 12
+        assert isinstance(rb, DecodeResult) and len(rb.tokens) == 2
+        done = {e["stream"]: e for e in
+                events_mod.default_log().events("prefill_complete")}
+        # b's 8-token prompt was throttled to 1 token/step: 8 chunks
+        assert done[b.stream_id]["chunks"] == 8
+        _idle(eng)
+    finally:
+        eng.close(timeout=2.0)
+        events_mod.set_default_log(prev)
 
 
 def test_parity_survives_scrambled_page_placement(engine):

@@ -558,11 +558,14 @@ def _tiny_decode_target(name="tiny_decode"):
         from perceiver_tpu.serving.decode import DecodeGeometry
 
         task = _tiny_mlm()
+        # mixed phase: row 0 prefills a 3-token chunk, row 1 decodes
         return task, {
             "geometry": DecodeGeometry(max_streams=2, num_pages=5,
-                                       page_size=4, max_seq_len=16),
-            "tokens": jnp.asarray([7, 9], jnp.int32),
-            "active": jnp.ones((2,), jnp.bool_),
+                                       page_size=4, max_seq_len=16,
+                                       max_chunk=4),
+            "tokens": jnp.asarray([[7, 9, 11, 0], [9, 0, 0, 0]],
+                                  jnp.int32),
+            "qlens": jnp.asarray([3, 1], jnp.int32),
         }
 
     return StepTarget(name=name, build=build, kind="decode")
@@ -577,11 +580,11 @@ def test_decode_targets_registered_and_budgeted():
     from perceiver_tpu.analysis.shardcheck import load_shard_budgets
 
     names = {t.name for t in DECODE_TARGETS}
-    assert names == {"decode_mlm_r8_p64x16"}
+    assert names == {"decode_mixed_mlm_r8_p64x16_q8"}
     assert all(t.kind == "decode" for t in DECODE_TARGETS)
     canonical = {t.name for t in CANONICAL_TARGETS}
     assert names <= canonical
-    spmd = "decode_mlm_spmd_r8_p48x16_dp2_tp2"
+    spmd = "decode_mixed_mlm_spmd_r8_p48x16_q8_dp2_tp2"
     assert spmd in canonical
     assert names | {spmd} <= set(load_hbm_budgets())
     shard = load_shard_budgets()

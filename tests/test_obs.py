@@ -164,6 +164,30 @@ def test_decode_phases_and_stream_events_in_vocabulary():
                                                  "stream_close"]
 
 
+def test_prefill_phases_and_scheduler_events_in_vocabulary():
+    """ISSUE 17: the unified prefill+decode scheduler speaks the
+    closed vocabulary too — ``prefill_chunk`` spans each chunked-
+    prefill slice of a prompt, ``stream_admitted`` fires on slot+page
+    grant, ``prefill_complete`` when the last chunk lands. A
+    vocabulary miss would make chunked prefill raise on the first
+    admitted prompt."""
+    assert "prefill_chunk" in trace_mod.PHASES
+    sink = SpanCollector()
+    ctx = trace_mod.start_trace(origin="decode", sink=sink)
+    ctx.record("prefill_chunk", duration_s=0.001, stream="s1",
+               chunk=8, fed=8)
+    assert [s["phase"] for s in sink.spans] == ["prefill_chunk"]
+
+    log = EventLog()
+    validate_event(log.emit("stream_admitted", stream="s1", pages=4))
+    validate_event(log.emit("prefill_complete", stream="s1",
+                            prompt_tokens=9, chunks=2))
+    with pytest.raises(ValueError, match="missing required"):
+        log.emit("prefill_complete", stream="s1")  # counts required
+    assert [e["type"] for e in log.events()] == ["stream_admitted",
+                                                 "prefill_complete"]
+
+
 def test_event_log_ring_and_jsonl_mirror(tmp_path):
     path = str(tmp_path / "events.jsonl")
     log = EventLog(path)

@@ -183,3 +183,105 @@ def test_survives_jit():
         jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
         jnp.asarray(tables), jnp.asarray(lengths)))
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+# --- ragged mode: per-row (kv_len, query_len), mixed prefill + decode ------
+
+from perceiver_tpu.ops.paged_attention import (  # noqa: E402
+    ragged_paged_attention,
+    ragged_paged_attention_reference,
+)
+
+
+def _dense_ragged_reference(q, k, v, kv_len, q_len, causal):
+    """Per-query-row oracle: query i of a causal row attends kv
+    positions < kv_len - (q_len - 1 - i); non-causal rows see the
+    whole cache. Padding rows and empty windows are exact zeros."""
+    h, nq, d = q.shape
+    out = np.zeros((h, nq, d), np.float32)
+    for i in range(nq):
+        if i >= q_len:
+            continue
+        limit = kv_len - (q_len - 1 - i) if causal else kv_len
+        if limit <= 0:
+            continue
+        out[:, i:i + 1, :] = _dense_reference(
+            q[:, i:i + 1, :], k, v, int(limit))
+    return out
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ragged_mixed_rows_match_dense_oracle(causal):
+    """One call, mixed traffic: chunked-prefill rows (q_len 8 / 5 / 3)
+    and decode rows (q_len 1) — the unified serving step's shape."""
+    rng = np.random.default_rng(10)
+    q, kp, vp, tables, kv_lens, dk, dv = _make_case(
+        rng, lengths=(29, 8, 17, 1), nq=8)
+    q_lens = np.asarray([8, 5, 1, 1], np.int32)
+    out = np.asarray(ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(kv_lens),
+        jnp.asarray(q_lens), causal=causal))
+    for i in range(len(kv_lens)):
+        want = _dense_ragged_reference(
+            q[i], dk[i], dv[i], int(kv_lens[i]), int(q_lens[i]), causal)
+        np.testing.assert_allclose(out[i], want, rtol=2e-5, atol=2e-5)
+        # padding query rows are exact zeros, not just small
+        np.testing.assert_array_equal(out[i][:, int(q_lens[i]):, :], 0.0)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ragged_kernel_matches_reference(dtype, causal):
+    rng = np.random.default_rng(11)
+    q, kp, vp, tables, kv_lens, _, _ = _make_case(
+        rng, lengths=(32, 7, 12, 2), nq=8)
+    q_lens = jnp.asarray([8, 4, 1, 2], jnp.int32)
+    args = [jnp.asarray(a).astype(dtype) for a in (q, kp, vp)]
+    got = ragged_paged_attention(
+        *args, jnp.asarray(tables), jnp.asarray(kv_lens), q_lens,
+        causal=causal)
+    want = ragged_paged_attention_reference(
+        *args, jnp.asarray(tables), jnp.asarray(kv_lens), q_lens,
+        causal=causal)
+    assert got.dtype == want.dtype
+    tol = 2e-5 if dtype == np.float32 else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=tol, atol=tol)
+
+
+def test_ragged_empty_causal_windows_are_exact_zero():
+    """kv_len < q_len leaves the earliest chunk queries with empty
+    windows (limit <= 0): exact zeros, never NaN — NEG_INF is finite
+    by design and the wrapper zeroes those rows."""
+    rng = np.random.default_rng(12)
+    q, kp, vp, tables, kv_lens, dk, dv = _make_case(
+        rng, lengths=(2, 0, 5, 3), nq=8)
+    q_lens = np.asarray([5, 3, 8, 3], np.int32)  # rows 0/1 underfull
+    out = np.asarray(ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(kv_lens),
+        jnp.asarray(q_lens), causal=True))
+    assert np.isfinite(out).all()
+    for i in range(len(kv_lens)):
+        want = _dense_ragged_reference(
+            q[i], dk[i], dv[i], int(kv_lens[i]), int(q_lens[i]), True)
+        np.testing.assert_allclose(out[i], want, rtol=2e-5, atol=2e-5)
+    # row 1 has no cache at all: everything zero
+    np.testing.assert_array_equal(out[1], 0.0)
+
+
+def test_decode_delegate_is_ragged_noncausal():
+    """paged_decode_attention must stay a thin delegate of the ragged
+    path (all query rows live, non-causal) — bitwise identical."""
+    rng = np.random.default_rng(13)
+    q, kp, vp, tables, lengths, _, _ = _make_case(rng)
+    a = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths))
+    b = ragged_paged_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths),
+        jnp.full((q.shape[0],), q.shape[2], jnp.int32), causal=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

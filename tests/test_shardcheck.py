@@ -482,7 +482,8 @@ def _tiny_decode_spmd_target():
 
         # vocab 128 divides evenly over the model axis (tp2), streams
         # divide over data (dp2) — same divisibility rules as the
-        # canonical decode_mlm_spmd target, at compile-cheap shapes
+        # canonical decode_mixed_mlm_spmd target, at compile-cheap
+        # shapes; mixed qlens exercise the unified prefill+decode step
         task = MaskedLanguageModelTask(
             vocab_size=128, max_seq_len=32, num_latents=4,
             num_latent_channels=16, num_encoder_layers=2,
@@ -490,10 +491,11 @@ def _tiny_decode_spmd_target():
         rng = np.random.default_rng(0)
         return task, {
             "geometry": DecodeGeometry(max_streams=4, num_pages=9,
-                                       page_size=4, max_seq_len=32),
-            "tokens": jnp.asarray(rng.integers(3, 128, (4,)),
+                                       page_size=4, max_seq_len=32,
+                                       max_chunk=4),
+            "tokens": jnp.asarray(rng.integers(3, 128, (4, 4)),
                                   jnp.int32),
-            "active": jnp.ones((4,), jnp.bool_),
+            "qlens": jnp.asarray([4, 1, 2, 1], jnp.int32),
             "attn_impl": "reference",
         }
 
