@@ -66,10 +66,15 @@ from perceiver_tpu.utils.timing import fence
 # compiles (the batch-512 rung took ~650 s on the v5e compiler) — with
 # the cache, only the FIRST process in a window compiles each config.
 # Must be set before jax initializes; harmless for CPU smoke runs.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                 ".jax_cache"))  # same dir the watcher exports
+# Script runs only: when this module is imported as a library (the
+# supervisor tests exec it in-process) the setdefault would leak into
+# the host process's os.environ and from there into every child it
+# spawns — subtly changing their XLA compilation behaviour.
+if __name__ == "__main__":
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))  # same dir the watcher exports
 
 # Rung dicts, most → least aggressive. The top rung IS the round-5
 # on-chip winner (logs/perf_matrix_r05.jsonl: pallas streaming CE +

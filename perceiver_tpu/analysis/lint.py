@@ -140,6 +140,19 @@ Rules (names are the ``check`` field of emitted violations):
     or suppress per line with a reason when holding the lock IS the
     protocol (e.g. one-in-flight-per-connection RPC framing).
 
+``kv-alias``
+    A direct functional page write — ``X.at[...].set(...)`` / ``.add``
+    / any other ``.at`` update method — in a module under
+    ``perceiver_tpu/serving/`` other than ``serving/decode.py`` or
+    ``serving/prefix_cache.py``. With content-addressed prefix caching
+    (ISSUE 18) a KV page in the paged arena may be aliased by many
+    streams and by the prefix index; the copy-on-write discipline
+    (``ensure_private_page`` before any write) lives entirely in those
+    two modules, and a page write anywhere else in the serving layer
+    bypasses it — silently corrupting every other stream sharing the
+    page. Genuinely non-arena ``.at`` updates in serving code suppress
+    per line with a reason.
+
 Tracing detection is local and conservative: functions decorated with
 ``jax.jit`` / ``partial(jax.jit, ...)``, functions passed to a
 ``jax.jit(...)`` call anywhere in the module, and everything nested
@@ -898,6 +911,45 @@ def _check_unsharded_pjit(tree: ast.AST, path: str) -> List[Violation]:
     return out
 
 
+# serving/: CoW discipline — page writes only in the two CoW-aware
+# modules (decode.py enforces ensure_private_page; prefix_cache.py
+# defines it)
+_AT_UPDATE_METHODS = {"set", "add", "subtract", "multiply", "divide",
+                      "power", "min", "max", "apply"}
+_KV_ALIAS_EXEMPT = ("serving/decode.py", "serving/prefix_cache.py")
+
+
+def _check_kv_alias(tree: ast.AST, path: str) -> List[Violation]:
+    """``kv-alias``: see the module docstring. The match is the exact
+    JAX functional-update shape — a call on an attribute of an
+    ``.at[...]`` subscript — so ordinary dict/list ``.add``/``.set``
+    calls never trip it."""
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _AT_UPDATE_METHODS):
+            continue
+        sub = node.func.value
+        if not (isinstance(sub, ast.Subscript)
+                and isinstance(sub.value, ast.Attribute)
+                and sub.value.attr == "at"):
+            continue
+        out.append(Violation(
+            check="kv-alias",
+            where=f"{path}:{node.lineno}",
+            message=f".at[...].{node.func.attr}(...) page write outside "
+                    "the CoW-aware modules — KV pages may be aliased by "
+                    "the prefix index and other streams (refcount > 1), "
+                    "and only serving/decode.py (via "
+                    "ensure_private_page) and serving/prefix_cache.py "
+                    "uphold the copy-on-write discipline; route the "
+                    "write through the engine, or mark the line "
+                    "'graphcheck: ignore' with a reason if the target "
+                    "is provably not the paged arena"))
+    return out
+
+
 def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     """Lint one module's source. ``path`` is used for reporting and
     for the ops-scoped rule (a path containing ``/ops/``)."""
@@ -922,6 +974,9 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     if "perceiver_tpu/serving/" in norm \
             or "perceiver_tpu/fleet/" in norm:
         violations.extend(_check_condition_waits(tree, path))
+    if "perceiver_tpu/serving/" in norm and not norm.endswith(
+            _KV_ALIAS_EXEMPT):
+        violations.extend(_check_kv_alias(tree, path))
     if "perceiver_tpu/parallel/" in norm \
             or norm.endswith("perceiver_tpu/training/spmd.py"):
         violations.extend(_check_unsharded_pjit(tree, path))
@@ -980,7 +1035,7 @@ ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
              "impl-field-validation", "serving-host-sync",
              "uncached-compile", "silent-swallow", "router-blocking-io",
              "distributed-blocking-io", "unsharded-pjit",
-             "metrics-conventions", "blocking-under-lock")
+             "metrics-conventions", "blocking-under-lock", "kv-alias")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

@@ -139,11 +139,13 @@ class ReplicaServer:
         # covers both planes, and the compile listener above counts its
         # step compile in the zero-compile spin-up budget
         self.decode_engine = None
+        self._prefix_cache_cfg = None
         if spec.get("decode"):
             from perceiver_tpu.serving.decode import (
                 DecodeEngine,
                 DecodeGeometry,
             )
+            from perceiver_tpu.serving.prefix_cache import PrefixCacheConfig
 
             dspec = dict(spec["decode"])
             self._decode_max_new = int(dspec.pop("max_new_tokens_default",
@@ -151,10 +153,20 @@ class ReplicaServer:
             # host-side pacing knob of the unified prefill+decode
             # scheduler; everything left in dspec is geometry
             token_budget = dspec.pop("token_budget", None)
+            # opt-in prefix caching (spec key "prefix_cache" = config
+            # kwargs, or true for defaults) — purely host-side page
+            # sharing, so it never forks the exec-cache key
+            pc = dspec.pop("prefix_cache", None)
+            if pc is True:
+                pc = PrefixCacheConfig()
+            elif isinstance(pc, dict):
+                pc = PrefixCacheConfig(**pc)
+            self._prefix_cache_cfg = pc
             self.decode_engine = DecodeEngine(
                 task, self.engine._params_src,
                 geometry=DecodeGeometry(**dspec),
                 token_budget=token_budget,
+                prefix_cache=pc,
                 metrics=self.engine.metrics)
         self.server = RpcServer(self.handle,
                                 port=int(spec.get("port", 0)),
@@ -289,6 +301,11 @@ class ReplicaServer:
             "breaker_open_buckets": (int(open_buckets.value)
                                      if open_buckets else 0),
             "faults_fired": faults.counts(),
+            # advertised so routers/operators can see which replicas
+            # share KV prefixes (None = decode absent or caching off)
+            "prefix_cache": (
+                {"max_pages": self._prefix_cache_cfg.max_pages}
+                if self._prefix_cache_cfg is not None else None),
         }
 
     def _update_version(self, version: str) -> dict:

@@ -78,6 +78,13 @@ SCHEMA: Dict[str, Tuple[str, ...]] = {
     "stream_admitted": ("stream", "pages"),
     "prefill_complete": ("stream", "prompt_tokens", "chunks"),
     "stream_close": ("stream", "tokens"),
+    # prefix caching (serving/prefix_cache.py): hit/miss at admission
+    # lookup, publish when prefill hands full prompt-only pages back
+    # to the index, evict when LRU reclaim frees index-only pages.
+    "prefix_cache_hit": ("stream", "tokens", "pages"),
+    "prefix_cache_miss": ("stream",),
+    "prefix_cache_publish": ("stream", "pages"),
+    "prefix_cache_evict": ("pages",),
 }
 
 

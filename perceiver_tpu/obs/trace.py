@@ -68,6 +68,7 @@ PHASES = (
     "decode_step",  # decode engine: one stepped-executable iteration
     "prefill_chunk",  # decode engine: one chunked-prefill slice of a prompt
     "token_emit",   # decode engine: one generated token handed out
+    "prefix_lookup",  # decode engine: prefix-cache probe at admission
 )
 
 _enabled = True

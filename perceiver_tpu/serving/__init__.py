@@ -37,6 +37,11 @@ from perceiver_tpu.serving.decode import (  # noqa: F401
     StreamHandle,
     build_decode_graph,
 )
+from perceiver_tpu.serving.prefix_cache import (  # noqa: F401
+    PrefixCacheConfig,
+    PrefixIndex,
+    ensure_private_page,
+)
 from perceiver_tpu.serving.errors import (  # noqa: F401
     BatchError,
     ServingError,

@@ -398,9 +398,14 @@ class GenerationServer:
     Unlike the batch servers above there is no micro-batcher in front:
     the decode engine IS the continuous batcher — every submit joins
     the stepped executable's next admission wave, and tokens stream
-    back per step. This layer only tokenizes, decodes, and exposes the
-    three delivery shapes: blocking (:meth:`generate`), incremental
-    (:meth:`stream`), and push (:meth:`submit` with ``on_token``).
+    back per step. When the engine was built with
+    ``prefix_cache=PrefixCacheConfig(...)``, prompts sharing a
+    page-aligned prefix reuse cached KV pages transparently
+    (docs/SERVING.md "Prefix caching"; :meth:`prefix_cache_stats`
+    surfaces the index accounting). This layer only tokenizes,
+    decodes, and exposes the three delivery shapes: blocking
+    (:meth:`generate`), incremental (:meth:`stream`), and push
+    (:meth:`submit` with ``on_token``).
     """
 
     def __init__(self, engine, tokenizer):
@@ -458,6 +463,10 @@ class GenerationServer:
 
     def metrics_text(self) -> str:
         return self.engine.metrics_text()
+
+    def prefix_cache_stats(self):
+        """Prefix-index accounting dict, or None when caching is off."""
+        return self.engine.prefix_cache_stats()
 
     def close(self, timeout: float = 5.0) -> None:
         self.engine.close(timeout)

@@ -428,6 +428,14 @@ class ContinuousBatchScheduler:
         """Pop the admissible FIFO prefix: entries admit while ``slots``
         remain and their cost fits the remaining ``budget``; expired
         heads shed along the way. Returns ``(admitted, shed)`` items.
+
+        ``budget`` is whatever the caller can actually provide by
+        admission time, not just what is free right now — the decode
+        engine passes ``pool.free_pages +
+        prefix_index.evictable_pages()`` (the kv-share seam: pages
+        held only by the prefix index are reclaimed on demand, and a
+        cached-prefix hit draws fewer pages than the conservative
+        per-item cost, so charging full cost here stays safe).
         """
         if now is None:
             now = self._clock()

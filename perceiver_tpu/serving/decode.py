@@ -92,6 +92,11 @@ from perceiver_tpu.serving.engine import (
 )
 from perceiver_tpu.serving.errors import BatchError, Unavailable
 from perceiver_tpu.serving.metrics import MetricsRegistry
+from perceiver_tpu.serving.prefix_cache import (
+    PrefixCacheConfig,
+    PrefixIndex,
+    ensure_private_page,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -147,13 +152,18 @@ class DecodeGeometry:
 
 
 class PagePool:
-    """Host-side free-list allocator over the pool's page indices.
+    """Host-side refcounted free-list allocator over page indices.
 
     Page 0 is reserved (the trash page inactive slots scatter into)
     and never handed out. Any free page serves any stream, so recycle
     never fragments: ``free`` simply pushes pages back on the list.
-    The allocated set is tracked to make double-free / aliasing bugs
-    loud instead of silently corrupting a neighbour stream's cache.
+    Pages carry a reference count so immutable prefix pages can be
+    shared across streams (serving/prefix_cache.py): ``alloc`` hands
+    out pages at refcount 1, ``incref`` adds a holder, and ``free`` is
+    a decref that only returns the page to the free list when the last
+    holder lets go. The allocated map is tracked to make double-free /
+    aliasing bugs loud instead of silently corrupting a neighbour
+    stream's cache.
     """
 
     # externally guarded: a PagePool has no lock of its own — every
@@ -171,7 +181,7 @@ class PagePool:
         # reuse just-freed pages (cache-friendly, and makes the
         # recycle tests deterministic)
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._allocated: set = set()
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
@@ -179,7 +189,16 @@ class PagePool:
 
     @property
     def allocated_pages(self) -> int:
-        return len(self._allocated)
+        return len(self._refs)
+
+    @property
+    def _allocated(self) -> set:
+        """Allocated page-id view (kept for tests / introspection)."""
+        return set(self._refs)
+
+    def refcount(self, page: int) -> int:
+        """Holders of ``page`` (0 when the page is on the free list)."""
+        return self._refs.get(page, 0)
 
     def alloc(self, n: int) -> List[int]:
         if n < 1:
@@ -189,17 +208,30 @@ class PagePool:
                 f"pool exhausted: {n} pages requested, "
                 f"{len(self._free)} free")
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
-    def free(self, pages: Sequence[int]) -> None:
+    def incref(self, pages: Sequence[int]) -> None:
+        """Add one holder to each page (prefix sharing / publication)."""
         for p in pages:
-            if p not in self._allocated:
+            if p not in self._refs:
+                raise ValueError(
+                    f"incref of unallocated page {p} (allocated: "
+                    f"{sorted(self._refs)})")
+            self._refs[p] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one holder per page; recycle pages that hit zero."""
+        for p in pages:
+            if p not in self._refs:
                 raise ValueError(
                     f"double-free or foreign page {p} (allocated: "
-                    f"{sorted(self._allocated)})")
-            self._allocated.remove(p)
-            self._free.append(p)
+                    f"{sorted(self._refs)})")
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -426,6 +458,7 @@ class DecodeResult:
     prompt_len: int
     finished: str                 # "complete" | "cancelled"
     ttft_s: Optional[float]
+    cached_tokens: int = 0        # prompt span served from the prefix cache
 
 
 class _Stream:
@@ -435,13 +468,15 @@ class _Stream:
                  "on_token", "ctx", "enqueued_at", "deadline", "slot",
                  "pages", "fed", "next_input", "generated", "tokens_q",
                  "done", "outcome", "error", "ttft_s", "submitted_at",
-                 "prefill_chunks")
+                 "prefill_chunks", "cached_tokens", "shared_pages")
 
     def __init__(self, sid, prompt, max_new, pages_needed, on_token,
                  ctx, now, deadline):
         self.sid = sid
         self.seq = int(sid[1:])  # admission order (FIFO chunk planning)
         self.prefill_chunks = 0
+        self.cached_tokens = 0   # prefix-cache hit span (page-aligned)
+        self.shared_pages = 0    # leading table entries shared via the index
         self.prompt = prompt
         self.max_new = max_new
         self.pages_needed = pages_needed
@@ -529,6 +564,7 @@ class DecodeEngine:
         "_carry": "_lock",
         "params": "_lock",
         "pool": "_lock",
+        "prefix_index": "_lock",
     }
 
     def __init__(self, task, params=None, *,
@@ -539,6 +575,7 @@ class DecodeEngine:
                  metrics: Optional[MetricsRegistry] = None,
                  max_queue: int = 64,
                  token_budget: Optional[int] = None,
+                 prefix_cache: Optional[PrefixCacheConfig] = None,
                  auto_step: bool = True,
                  seed: int = 0):
         import jax
@@ -586,9 +623,31 @@ class DecodeEngine:
         self._m_prefill_tokens = m.counter(
             "serving_decode_prefill_tokens_total",
             "prompt tokens consumed via chunked prefill")
+        self._m_prefix_hits = m.counter(
+            "serving_prefix_cache_hits_total",
+            "admissions whose prompt matched a cached prefix")
+        self._m_prefix_misses = m.counter(
+            "serving_prefix_cache_misses_total",
+            "admissions with no cached prefix")
+        self._m_prefix_hit_tokens = m.counter(
+            "serving_prefix_cache_hit_tokens_total",
+            "prompt tokens served from shared prefix pages")
+        self._m_prefix_evicted = m.counter(
+            "serving_prefix_cache_evicted_pages_total",
+            "index pages reclaimed by LRU eviction")
+        self._m_prefix_pages = m.gauge(
+            "serving_prefix_cache_pages",
+            "pages currently held by the prefix index")
 
         r = geometry.max_streams
         self.pool = PagePool(geometry.num_pages, geometry.page_size)
+        # prefix sharing is an opt-in host-side discipline over the
+        # same arena: enabling it changes no compiled shape — the
+        # geometry descriptor (and so the exec-cache key) is identical
+        # with the index on or off
+        self.prefix_index: Optional[PrefixIndex] = (
+            PrefixIndex(self.pool, geometry.page_size, prefix_cache)
+            if prefix_cache is not None else None)
         self._m_free_pages.set(self.pool.free_pages)
         self._queue = ContinuousBatchScheduler(
             max_depth=max_queue, token_budget=self.token_budget,
@@ -694,8 +753,14 @@ class DecodeEngine:
 
     def _admit_locked(self, now: float) -> None:
         free_slots = sum(1 for s in self._streams if s is None)
+        # index-only pages are reclaimable on demand, so they count
+        # toward the admission budget — a full index never starves
+        # admission (it just loses its least-recently-hit chains)
+        budget = self.pool.free_pages
+        if self.prefix_index is not None:
+            budget += self.prefix_index.evictable_pages()
         admitted, shed = self._queue.take(
-            budget=self.pool.free_pages, slots=free_slots, now=now)
+            budget=budget, slots=free_slots, now=now)
         for stream in shed:
             self._m_shed.labels(reason="deadline").inc()
             self._resolve_shed(stream, Overloaded(
@@ -704,11 +769,53 @@ class DecodeEngine:
             slot = next(i for i, s in enumerate(self._streams)
                         if s is None)
             stream.slot = slot
-            stream.pages = self.pool.alloc(stream.pages_needed)
+            shared: List[int] = []
+            if self.prefix_index is not None:
+                t_lk = time.monotonic()
+                cached, shared = self.prefix_index.lookup(stream.prompt)
+                stream.cached_tokens = cached
+                stream.shared_pages = len(shared)
+                if stream.ctx is not None:
+                    stream.ctx.record(
+                        "prefix_lookup", start=t_lk,
+                        end=time.monotonic(), stream=stream.sid,
+                        cached_tokens=cached, pages=len(shared))
+                if cached > 0:
+                    self._m_prefix_hits.inc()
+                    self._m_prefix_hit_tokens.inc(cached)
+                    events_mod.emit("prefix_cache_hit",
+                                    stream=stream.sid, tokens=cached,
+                                    pages=len(shared))
+                else:
+                    self._m_prefix_misses.inc()
+                    events_mod.emit("prefix_cache_miss",
+                                    stream=stream.sid)
+            # the cached span is page-aligned and strictly shorter
+            # than the prompt, so >= 1 private page is always needed
+            # (the partial last page is never shared)
+            private_needed = stream.pages_needed - len(shared)
+            if (self.prefix_index is not None
+                    and private_needed > self.pool.free_pages):
+                evicted = self.prefix_index.evict(
+                    private_needed - self.pool.free_pages)
+                if evicted:
+                    self._m_prefix_evicted.inc(evicted)
+                    events_mod.emit("prefix_cache_evict", pages=evicted)
+            private = self.pool.alloc(private_needed)
+            for p in private:
+                # CoW discipline: every page this stream will write is
+                # exclusively held — shared pages only ever serve reads
+                ensure_private_page(self.pool, p)
+            stream.pages = shared + private
+            stream.fed = stream.cached_tokens
             self._streams[slot] = stream
             self._tables[slot, :] = 0
             self._tables[slot, :len(stream.pages)] = stream.pages
-            self._lengths[slot] = 0
+            # positions continue after the cached span: the carry's
+            # length row starts at cached_tokens, so the tail chunk
+            # prefills (and attends) exactly as a cold stream that
+            # had already written those positions
+            self._lengths[slot] = stream.cached_tokens
             self._dirty = True
             if stream.ctx is not None:
                 stream.ctx.record("queue_wait", start=stream.enqueued_at,
@@ -719,6 +826,8 @@ class DecodeEngine:
             self._m_active.set(
                 sum(1 for s in self._streams if s is not None))
             self._m_free_pages.set(self.pool.free_pages)
+        if self.prefix_index is not None:
+            self._m_prefix_pages.set(self.prefix_index.pages_indexed)
 
     def step(self) -> int:
         """Run one unified step over every occupied slot (admitting
@@ -799,7 +908,18 @@ class DecodeEngine:
                     # already produced the first generated token below
                     events_mod.emit("prefill_complete", stream=s.sid,
                                     prompt_tokens=len(s.prompt),
-                                    chunks=s.prefill_chunks)
+                                    chunks=s.prefill_chunks,
+                                    cached_tokens=s.cached_tokens)
+                    if self.prefix_index is not None:
+                        # every full prompt-only page is now written;
+                        # publish the ones the index doesn't know yet
+                        pub = self.prefix_index.publish(
+                            s.prompt, s.pages)
+                        if pub:
+                            events_mod.emit("prefix_cache_publish",
+                                            stream=s.sid, pages=pub)
+                        self._m_prefix_pages.set(
+                            self.prefix_index.pages_indexed)
                 else:
                     s.fed += 1
                     if s.ctx is not None:
@@ -875,7 +995,8 @@ class DecodeEngine:
         self._m_streams.labels(outcome=how).inc()
         s.outcome = DecodeResult(
             tokens=list(s.generated), prompt_len=len(s.prompt),
-            finished=how, ttft_s=s.ttft_s)
+            finished=how, ttft_s=s.ttft_s,
+            cached_tokens=s.cached_tokens)
 
     def _resolve_shed(self, s: _Stream, overloaded: Overloaded) -> None:
         self._m_streams.labels(outcome="shed").inc()
@@ -921,11 +1042,43 @@ class DecodeEngine:
         compiled step. Callers quiesce first (the replica cutover's
         inflight guard covers decode dispatches end-to-end); a stream
         admitted after the swap generates entirely under the new tree,
-        so no stream ever mixes KV from two versions."""
+        so no stream ever mixes KV from two versions. Cached prefix
+        pages are a function of the weights, so the prefix index is
+        flushed here — a retained cache would serve stale KV."""
         import jax
 
         with self._lock:
             self.params = jax.device_put(params)
+            if self.prefix_index is not None:
+                self.prefix_index.clear()
+                self._m_prefix_pages.set(0)
+
+    def flush_prefix_cache(self) -> int:
+        """Drop every index-held page (tests / tenant teardown).
+
+        Pages shared by in-flight streams survive under the streams'
+        own references; returns pages released by the index."""
+        with self._lock:
+            if self.prefix_index is None:
+                return 0
+            released = self.prefix_index.clear()
+            self._m_prefix_pages.set(0)
+            self._m_free_pages.set(self.pool.free_pages)
+            return released
+
+    def prefix_cache_stats(self) -> Optional[Dict[str, int]]:
+        """Point-in-time index accounting (None when caching is off)."""
+        with self._lock:
+            if self.prefix_index is None:
+                return None
+            return {
+                "pages_indexed": self.prefix_index.pages_indexed,
+                "evictable_pages": self.prefix_index.evictable_pages(),
+                "hits": int(self._m_prefix_hits.value_of()),
+                "misses": int(self._m_prefix_misses.value_of()),
+                "hit_tokens": int(self._m_prefix_hit_tokens.value_of()),
+                "evicted_pages": int(self._m_prefix_evicted.value_of()),
+            }
 
     @property
     def active_streams(self) -> int:

@@ -1,0 +1,306 @@
+# Copyright 2026.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or
+# implied. See the License for the specific language governing
+# permissions and limitations under the License.
+"""Content-addressed prefix caching over the paged KV arena.
+
+The millions-of-users decode workload is dominated by shared prefixes
+(system prompts, few-shot templates, per-tenant preambles), yet a cold
+admission re-prefills every prompt from token 0. This module makes KV
+pages *content-addressed*: a host-side trie maps page-aligned token
+blocks to immutable, refcounted pages in the engine's shared
+:class:`~perceiver_tpu.serving.decode.PagePool`, so a new stream whose
+prompt starts with a cached prefix begins life with its page table
+pointing at the shared pages and only chunk-prefills the tail.
+
+Design invariants (docs/SERVING.md#prefix-caching spells these out):
+
+- **Page-aligned content addressing.** A trie node's edge key is the
+  exact tuple of ``page_size`` token ids filling one page. Keys are
+  exact content (Python's dict hashing with full-equality probing), so
+  a lookup can never alias two different prefixes — token-exactness is
+  structural, not probabilistic.
+- **Only full, prompt-only pages are published.** A page enters the
+  index only once prefill has written every slot in it from prompt
+  tokens. Generated tokens land at positions ``>= len(prompt)``, which
+  by construction live in later pages, so a published page is never
+  written again: immutability needs no device-side copy.
+- **The partial last page is always private.** A lookup is capped at
+  ``(len(prompt) - 1) // page_size`` pages so at least one tail token
+  always goes through chunk prefill into freshly allocated private
+  pages. All KV writes for a warm stream therefore target pages with
+  refcount 1 — copy-on-write reduces to the admission-time discipline
+  enforced by :func:`ensure_private_page` (zero device copies, zero
+  new executables, the stepped-executable signature untouched).
+- **Uniform refcounting.** A stream holds one pool reference on every
+  page in its table (from ``alloc`` for private pages, ``incref`` for
+  shared ones); the index holds one reference per published page.
+  Stream teardown is a uniform ``pool.free`` decref — shared pages
+  survive at the index's reference, private ones recycle.
+- **LRU eviction under the page budget.** A chain whose pages are held
+  only by the index (pool refcount 1, i.e. stream refcount 0) is
+  evictable, leaf-first, least-recently-hit first. The engine admits
+  against ``pool.free_pages + index.evictable_pages()`` so a full
+  index never starves admission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "PrefixCacheConfig",
+    "PrefixIndex",
+    "ensure_private_page",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Knobs for the prefix index.
+
+    ``max_pages`` caps how many pages the index may retain after a
+    publication (best-effort: pages still referenced by live streams
+    cannot be evicted and are trimmed once their holders finish).
+    ``None`` means the only bound is the arena itself — the admission
+    budget reclaims index-only pages on demand.
+    """
+
+    max_pages: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_pages is not None and self.max_pages < 0:
+            raise ValueError(
+                f"max_pages must be >= 0 or None, got {self.max_pages}")
+
+
+def ensure_private_page(pool, page: int) -> int:
+    """CoW guard: assert ``page`` is exclusively held before writes.
+
+    Every page that will receive KV writes must be private — held by
+    exactly one owner (the writing stream) and never the reserved
+    trash page 0. The admission path routes all writable positions to
+    freshly allocated pages, so this guard is the loud backstop that
+    turns an aliasing bug into an exception instead of silent KV
+    corruption of a neighbour stream (the kv-alias lint rule points
+    direct writers here).
+    """
+    if page == 0:
+        raise ValueError("page 0 is the reserved trash page — never "
+                         "writable through the allocator")
+    rc = pool.refcount(page)
+    if rc != 1:
+        raise ValueError(
+            f"copy-on-write violation: page {page} has refcount {rc}; "
+            f"a writable page must be exclusively held (refcount 1)")
+    return page
+
+
+class _PrefixNode:
+    """One published page: a page-aligned token block in the trie."""
+
+    __slots__ = ("key", "page", "parent", "children", "last_hit")
+
+    def __init__(self, key: Tuple[int, ...], page: int,
+                 parent: Optional["_PrefixNode"]):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
+        self.last_hit = 0
+
+
+class PrefixIndex:
+    """Host-side trie from page-aligned token blocks to shared pages.
+
+    Depth-``d`` nodes hold the page for prompt positions
+    ``[d*page_size, (d+1)*page_size)``; the path from the root spells
+    the token content of the cached prefix. All methods mutate shared
+    refcount state and MUST be called under the owning engine's lock —
+    like :class:`~perceiver_tpu.serving.decode.PagePool`, the index
+    has no lock of its own (racecheck validates the declaration; the
+    engine's ``_GUARDED`` registry covers the call sites).
+    """
+
+    _GUARDED_BY = "DecodeEngine._lock"
+
+    def __init__(self, pool, page_size: int,
+                 config: Optional[PrefixCacheConfig] = None):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.pool = pool
+        self.page_size = int(page_size)
+        self.config = config or PrefixCacheConfig()
+        self._root: Dict[Tuple[int, ...], _PrefixNode] = {}
+        self._by_page: Dict[int, _PrefixNode] = {}
+        self._clock = 0  # logical LRU clock, bumped per lookup/publish
+
+    # ------------------------------------------------------------------
+    # introspection
+
+    @property
+    def pages_indexed(self) -> int:
+        return len(self._by_page)
+
+    def evictable_pages(self) -> int:
+        """Pages reclaimable right now: nodes whose whole subtree is
+        held only by the index (pool refcount 1). Eviction proceeds
+        leaf-first, so a node pinned by a live stream also pins its
+        ancestors (their chain cannot be cut mid-path)."""
+
+        # A pinned descendant vetoes its ancestors (their chain cannot
+        # be cut mid-path): walk with an explicit (count, clean) pair.
+        def walk(node: _PrefixNode) -> Tuple[int, bool]:
+            count, clean = 0, self.pool.refcount(node.page) == 1
+            for child in node.children.values():
+                c, ok = walk(child)
+                count += c
+                clean = clean and ok
+            return (count + 1, True) if clean else (count, False)
+
+        return sum(walk(n)[0] for n in self._root.values())
+
+    def contains(self, prompt: Sequence[int]) -> int:
+        """Cached page-aligned span for ``prompt`` WITHOUT taking refs
+        (pure query — no LRU bump, no incref). Returns token count."""
+        cap = max(0, (len(prompt) - 1)) // self.page_size
+        level, depth = self._root, 0
+        while depth < cap:
+            key = tuple(int(t) for t in
+                        prompt[depth * self.page_size:
+                               (depth + 1) * self.page_size])
+            node = level.get(key)
+            if node is None:
+                break
+            level, depth = node.children, depth + 1
+        return depth * self.page_size
+
+    # ------------------------------------------------------------------
+    # admission-side API (engine lock held)
+
+    def lookup(self, prompt: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached page-aligned prefix of ``prompt``.
+
+        Returns ``(cached_tokens, pages)`` and takes one pool
+        reference per returned page on the caller's behalf (the
+        admitted stream's hold — released by the engine's uniform
+        teardown decref). Capped below ``len(prompt)`` so at least one
+        tail token always chunk-prefills into a private page.
+        """
+        self._clock += 1
+        cap = max(0, (len(prompt) - 1)) // self.page_size
+        pages: List[int] = []
+        level, depth = self._root, 0
+        while depth < cap:
+            key = tuple(int(t) for t in
+                        prompt[depth * self.page_size:
+                               (depth + 1) * self.page_size])
+            node = level.get(key)
+            if node is None:
+                break
+            node.last_hit = self._clock
+            pages.append(node.page)
+            level, depth = node.children, depth + 1
+        if pages:
+            self.pool.incref(pages)
+        return depth * self.page_size, list(pages)
+
+    def publish(self, prompt: Sequence[int],
+                pages: Sequence[int]) -> int:
+        """Publish a stream's full prompt-only pages back to the index.
+
+        ``pages`` is the stream's page table prefix (shared pages
+        first, then private) and ``prompt`` its full token sequence;
+        page ``i`` is publishable iff ``(i+1)*page_size <=
+        len(prompt)`` (fully covered by prompt tokens — generated
+        tokens live strictly later). Already-indexed blocks are left
+        in place (first publisher wins; the duplicate private page
+        stays private to its stream and recycles at teardown). Newly
+        adopted pages get one index reference. Returns the number of
+        pages newly published.
+        """
+        self._clock += 1
+        num_full = len(prompt) // self.page_size
+        published = 0
+        level, parent = self._root, None
+        for i in range(num_full):
+            key = tuple(int(t) for t in
+                        prompt[i * self.page_size:
+                               (i + 1) * self.page_size])
+            node = level.get(key)
+            if node is None:
+                page = int(pages[i])
+                if page == 0:
+                    raise ValueError(
+                        "refusing to publish reserved trash page 0")
+                node = _PrefixNode(key, page, parent)
+                self.pool.incref([page])
+                level[key] = node
+                self._by_page[page] = node
+                published += 1
+            node.last_hit = self._clock
+            level, parent = node.children, node
+        if self.config.max_pages is not None:
+            excess = self.pages_indexed - self.config.max_pages
+            if excess > 0:
+                self.evict(excess)
+        return published
+
+    # ------------------------------------------------------------------
+    # eviction / teardown (engine lock held)
+
+    def evict(self, need: int) -> int:
+        """Free up to ``need`` pages, LRU leaf-first.
+
+        Only index-only pages (pool refcount 1) are candidates; a leaf
+        eviction may expose its parent as the next candidate. Returns
+        the number of pages actually freed.
+        """
+        freed = 0
+        while freed < need:
+            victim: Optional[_PrefixNode] = None
+            for node in self._by_page.values():
+                if node.children:
+                    continue
+                if self.pool.refcount(node.page) != 1:
+                    continue
+                if victim is None or node.last_hit < victim.last_hit:
+                    victim = node
+            if victim is None:
+                break
+            self._unlink(victim)
+            freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every index reference (weights changed / drain).
+
+        Pages still shared by live streams stay allocated under the
+        streams' own references; index-only pages recycle. Returns the
+        number of pages released by the index.
+        """
+        released = 0
+        for node in list(self._by_page.values()):
+            self.pool.free([node.page])
+            released += 1
+        self._root = {}
+        self._by_page = {}
+        return released
+
+    def _unlink(self, node: _PrefixNode) -> None:
+        assert not node.children, "evict is leaf-first by construction"
+        siblings = (node.parent.children if node.parent is not None
+                    else self._root)
+        del siblings[node.key]
+        del self._by_page[node.page]
+        self.pool.free([node.page])

@@ -16,6 +16,14 @@ import os
 # can stall on connect timeouts; synthetic fallbacks are the contract
 os.environ.setdefault("PERCEIVER_TPU_OFFLINE", "1")
 
+# a host-global persistent XLA compilation cache (bench.py exports one
+# for tunnel runs) breaks two tier-1 gates: chaos determinism replays
+# get executables compiled under foreign flags (near-tied logits flip)
+# and the shared-prefix bench's cold arm stops paying compiles (its
+# warm/cold TTFT gate measures exactly that cost). Tests and their
+# children always compile fresh.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -27,7 +27,15 @@ def bench(monkeypatch):
         "bench_under_test", os.path.join(_ROOT, "bench.py"))
     mod = importlib.util.module_from_spec(spec)
     monkeypatch.setenv("BENCH_WATCHDOG", "0")  # no daemon hard-exit
+    before = dict(os.environ)
     spec.loader.exec_module(mod)
+    # importing bench.py as a library must not mutate the host
+    # process's environment: a leaked JAX_COMPILATION_CACHE_DIR once
+    # poisoned every later-spawned test child (chaos determinism and
+    # the shared-prefix TTFT gate) via env inheritance
+    assert dict(os.environ) == before, (
+        "bench.py import leaked env vars: "
+        f"{set(os.environ.items()) ^ set(before.items())}")
     return mod
 
 

@@ -37,6 +37,20 @@ def test_chaos_fast_matrix_survives():
     mixed = by_metric["chaos_race_mixed_prefill"]["detail"]
     assert mixed["deterministic_replays"] == len(mixed["seeds"])
     assert mixed["admitted"] > 0 and mixed["planned_steps"] > 0
+    # prefix-cache eviction under flood (ISSUE 18): unique-prefix
+    # pressure forces LRU eviction while shared-prefix clients stream
+    # — token-exact vs a cold-prefill reference under seeded replayed
+    # schedules, zero dropped under free threads, and the arena fully
+    # reclaimable at drain (no refcount leak)
+    evict = by_metric["chaos_prefix_evict_under_load"]["detail"]
+    assert evict["token_exact"] is True
+    assert evict["dropped"] == 0
+    assert evict["leak_free"] is True
+    assert evict["evicted_pages"] >= 1
+    assert evict["client_hits"] >= 1
+    assert evict["deterministic_replays"] == len(evict["seeds"])
+    assert evict["client_requests"] > 0
+    assert evict["faults_fired"].get("prefix.evict_pressure", 0) >= 1
 
 
 def test_chaos_fleet_fast_survives():

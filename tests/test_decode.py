@@ -355,6 +355,85 @@ def test_chunked_prefill_parity_across_chunk_sizes(policy_name):
             f"{toks} vs full-recompute {ref}")
 
 
+@pytest.mark.parametrize("policy_name", ["fp32", "bf16"])
+def test_prefix_cache_warm_decode_token_exact(policy_name):
+    """ISSUE 18 merge gate: a warm stream (prefix-cache hit — shared
+    pages for the cached span, tail through normal chunk prefill)
+    generates tokens bitwise identical to a cold prefill of the same
+    prompt on a caching-disabled engine, for every cached-span/tail
+    split, under fp32 AND bf16, with ZERO new XLA compiles — and stays
+    exact after eviction forces a cold re-prefill and re-publication.
+    (The cold paged path itself is anchored to the full-recompute
+    oracle by test_paged_decode_matches_full_recompute; params are
+    seed-deterministic across engines, so cold-engine output IS the
+    oracle here. bf16 is the policy where a near-miss would show:
+    any KV delta on a shared page flips low-mantissa logits first.)"""
+    from perceiver_tpu.serving.prefix_cache import PrefixCacheConfig
+
+    policy = getattr(Policy, policy_name)()
+    rng = np.random.default_rng(18)
+    seed_prompt = rng.integers(0, VOCAB, size=17).astype(np.int32)
+    eng = DecodeEngine(small_task(),
+                       geometry=small_geometry(num_pages=33),
+                       policy=policy, auto_step=False, exec_cache=False,
+                       prefix_cache=PrefixCacheConfig())
+    cold_eng = DecodeEngine(small_task(),
+                            geometry=small_geometry(num_pages=33),
+                            policy=policy, auto_step=False,
+                            exec_cache=False)
+    try:
+        h = eng.submit(seed_prompt, max_new_tokens=2)
+        eng.run_until_idle()
+        assert h.result(1.0).cached_tokens == 0  # nothing cached yet
+        assert eng.prefix_index.pages_indexed == 4  # 17 // 4 full pages
+
+        def run_one(prompt, expect_cached):
+            h = eng.submit(prompt, max_new_tokens=5)
+            with compile_events() as events:
+                eng.run_until_idle()
+            assert events == [], f"sharing recompiled: {events}"
+            got = h.result(timeout=1.0)
+            assert isinstance(got, DecodeResult)
+            assert got.cached_tokens == expect_cached
+            hc = cold_eng.submit(prompt, max_new_tokens=5)
+            cold_eng.run_until_idle()
+            cold = hc.result(timeout=1.0)
+            assert cold.cached_tokens == 0
+            assert got.tokens == cold.tokens, (
+                f"{policy_name} warm stream (cached={expect_cached}, "
+                f"len={len(prompt)}) diverged: {got.tokens} vs cold "
+                f"prefill {cold.tokens}")
+            return got
+
+        # every cached-span/tail split: k shared pages + t-token tail
+        # through private chunk prefill (incl. tails that themselves
+        # span a full page and publish new branches)
+        for k, t in ((1, 1), (1, 3), (2, 1), (2, 4), (3, 2)):
+            tail = rng.integers(0, VOCAB, size=t).astype(np.int32)
+            run_one(np.concatenate([seed_prompt[:4 * k], tail]),
+                    expect_cached=4 * k)
+
+        # evict every chain (engine idle: all pages are index-only),
+        # then the same prompt re-prefills cold, re-publishes, and
+        # hits warm again — all three token-identical
+        with eng._lock:
+            evicted = eng.prefix_index.evict(
+                eng.prefix_index.pages_indexed)
+        assert evicted > 0 and eng.prefix_index.pages_indexed == 0
+        prompt = np.concatenate(
+            [seed_prompt[:8],
+             rng.integers(0, VOCAB, size=2).astype(np.int32)])
+        cold = run_one(prompt, expect_cached=0)  # post-eviction miss
+        rewarm = run_one(prompt, expect_cached=8)  # re-published hit
+        assert rewarm.tokens == cold.tokens
+        # hygiene: dropping the index refs makes the arena whole again
+        eng.flush_prefix_cache()
+        assert eng.pool.free_pages == eng.geometry.allocatable_pages
+    finally:
+        eng.close(timeout=2.0)
+        cold_eng.close(timeout=2.0)
+
+
 def test_chunked_prefill_spans_events_and_metrics():
     """A 9-token prompt through max_chunk=4 prefills in exactly 3
     steps (4+4+1); the completing step emits the first token. The obs

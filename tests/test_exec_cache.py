@@ -411,6 +411,52 @@ class TestEngineIntegration:
         assert m.get("serving_exec_cache_hits_total").value == 0
         eng.dispatch(_arrays(1, 16))
 
+    def test_prefix_cache_is_not_executable_key_material(self, tmp_path):
+        """ISSUE 18 pin: content-addressed prefix sharing is pure
+        host-side bookkeeping — enabling it must not change the
+        geometry descriptor or fork the exec-cache key, so a replica
+        that toggles the cache on warm-restarts into the SAME
+        deserialized decode executable (zero XLA compiles)."""
+        import re
+
+        from jax._src import monitoring as _monitoring
+
+        from perceiver_tpu.ops.policy import Policy
+        from perceiver_tpu.serving.decode import (
+            DecodeEngine,
+            DecodeGeometry,
+        )
+        from perceiver_tpu.serving.prefix_cache import PrefixCacheConfig
+
+        geometry = DecodeGeometry(max_streams=2, num_pages=9,
+                                  page_size=4, max_seq_len=32)
+        # the descriptor grammar is frozen: runs/pages/seq/chunk lanes
+        # only — no prefix-cache material may ever leak into it
+        assert re.fullmatch(r"r\d+_p\d+x\d+_s\d+_q\d+",
+                            geometry.descriptor), geometry.descriptor
+        cache_dir = str(tmp_path / "ec")
+        cold = DecodeEngine(_tiny_task(), geometry=geometry,
+                            policy=Policy.fp32(), auto_step=False,
+                            exec_cache=cache_dir)
+        cold.close(timeout=2.0)
+        events = []
+
+        def listener(name, **kwargs):
+            if "compile" in name:
+                events.append(name)
+
+        jax.monitoring.register_event_listener(listener)
+        try:
+            warm = DecodeEngine(_tiny_task(), geometry=geometry,
+                                policy=Policy.fp32(), auto_step=False,
+                                exec_cache=cache_dir,
+                                prefix_cache=PrefixCacheConfig())
+            warm.close(timeout=2.0)
+        finally:
+            _monitoring._unregister_event_listener_by_callback(listener)
+        assert events == [], (
+            f"prefix caching forked the executable key: {events}")
+
 
 # --- THE acceptance criterion ------------------------------------------------
 

@@ -636,6 +636,56 @@ def test_replica_serves_decode_payloads_over_rpc():
         replica.close()
 
 
+def test_replica_advertises_prefix_cache_config():
+    """ISSUE 18: the decode spec's opt-in ``prefix_cache`` key builds
+    the engine with content-addressed page sharing and the replica
+    advertises the config over the ``status`` RPC — the supervisor's
+    placement logic can route shared-prefix tenants to replicas that
+    actually cache. A spec without the key advertises None (sharing
+    stays off by default)."""
+    from perceiver_tpu.fleet.replica import ReplicaServer
+    from perceiver_tpu.fleet.supervisor import RpcReplicaHandle
+
+    spec = {
+        "task_class": "MaskedLanguageModelTask",
+        "task_kwargs": dict(
+            vocab_size=110, max_seq_len=32, num_latents=4,
+            num_latent_channels=8, num_encoder_layers=1,
+            num_encoder_self_attention_layers_per_block=1,
+            num_encoder_cross_attention_heads=1,
+            num_encoder_self_attention_heads=1,
+            num_decoder_cross_attention_heads=1, loss_impl="dense"),
+        "batch_buckets": [1],
+        "seq_buckets": [16],
+        "decode": {"max_streams": 2, "num_pages": 9, "page_size": 4,
+                   "max_seq_len": 32, "max_new_tokens_default": 4,
+                   "prefix_cache": {"max_pages": 6}},
+    }
+    replica = ReplicaServer(spec)
+    handle = RpcReplicaHandle("127.0.0.1", replica.server.port,
+                              dispatch_timeout_s=60.0)
+    try:
+        assert handle.status()["prefix_cache"] == {"max_pages": 6}
+        assert replica.decode_engine.prefix_index is not None
+        assert replica.decode_engine.prefix_index.config.max_pages == 6
+    finally:
+        handle.close()
+        replica.close()
+    # no prefix_cache key -> disabled and advertised as None
+    spec2 = dict(spec, decode={
+        "max_streams": 2, "num_pages": 9, "page_size": 4,
+        "max_seq_len": 32})
+    replica2 = ReplicaServer(spec2)
+    handle2 = RpcReplicaHandle("127.0.0.1", replica2.server.port,
+                               dispatch_timeout_s=60.0)
+    try:
+        assert handle2.status()["prefix_cache"] is None
+        assert replica2.decode_engine.prefix_index is None
+    finally:
+        handle2.close()
+        replica2.close()
+
+
 def test_replica_without_decode_rejects_prompt_payloads():
     """A replica built WITHOUT a decode spec fails ``prompt_ids``
     payloads deterministically (``BatchError`` over RPC), not as a

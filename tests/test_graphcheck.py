@@ -1097,7 +1097,8 @@ def test_check_cli_all_exits_zero():
     for shard_pass in ("collective_budget", "replication_check",
                        "per_shard_hbm_budget", "unsharded-pjit",
                        "guarded-attrs", "lock-order",
-                       "callback-under-lock", "blocking-under-lock"):
+                       "callback-under-lock", "blocking-under-lock",
+                       "kv-alias"):
         assert shard_pass in roster, r.stdout
     m = re.search(r"lowering (\d+) canonical target", r.stderr)
     assert m and int(m.group(1)) == len(CANONICAL_TARGETS), r.stderr
@@ -1214,3 +1215,54 @@ def test_lint_metrics_conventions_suppression_marker():
     suppressed = _METRIC_COUNTER_NO_TOTAL.replace(
         '"requests")', '"requests")  # graphcheck: ignore — legacy name')
     assert "metrics-conventions" not in _checks(suppressed)
+
+
+# --- kv-alias (ISSUE 18: CoW discipline on the paged arena) ------------------
+
+_KV_WRITE = """
+def stash(kpool, page, slot, x):
+    return kpool.at[page, slot].set(x)
+"""
+
+_KV_ADD = """
+def accumulate(vpool, page, x):
+    return vpool.at[page].add(x)
+"""
+
+_KV_CLEAN_DICT = """
+def remember(seen, page):
+    seen.add(page)
+    cfg = {}
+    cfg.setdefault("at", []).append(page)
+"""
+
+
+def test_lint_kv_alias_seeded():
+    """A functional page write anywhere in serving/ outside the two
+    CoW-aware modules bypasses ensure_private_page and corrupts every
+    stream aliasing the page."""
+    path = "perceiver_tpu/serving/other.py"
+    assert "kv-alias" in _checks(_KV_WRITE, path)
+    assert "kv-alias" in _checks(_KV_ADD, path)
+
+
+def test_lint_kv_alias_exempt_modules_and_scope():
+    # the two modules that uphold the CoW discipline are exempt
+    assert "kv-alias" not in _checks(
+        _KV_WRITE, "perceiver_tpu/serving/decode.py")
+    assert "kv-alias" not in _checks(
+        _KV_WRITE, "perceiver_tpu/serving/prefix_cache.py")
+    # the rule is serving-scoped: model/ops code writes arrays freely
+    assert "kv-alias" not in _checks(
+        _KV_WRITE, "perceiver_tpu/ops/attention.py")
+    # ordinary .add/.set calls without the .at[...] shape never trip
+    assert "kv-alias" not in _checks(
+        _KV_CLEAN_DICT, "perceiver_tpu/serving/other.py")
+
+
+def test_lint_kv_alias_suppression_marker():
+    suppressed = _KV_WRITE.replace(
+        ".set(x)",
+        ".set(x)  # graphcheck: ignore — scratch buffer, not the arena")
+    assert "kv-alias" not in _checks(
+        suppressed, "perceiver_tpu/serving/other.py")

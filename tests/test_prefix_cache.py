@@ -1,0 +1,360 @@
+"""PagePool-under-sharing + PrefixIndex invariants (ISSUE 18).
+
+Property-style randomized tests over the refcounted arena: refcounts
+never go negative (over-free raises instead), the reserved trash page
+0 is never handed out, indexed, or published, copy-on-write's
+``ensure_private_page`` guard rejects every shared page, and the
+conservation law ``free + allocated == num_pages - 1`` holds after
+every operation — with full teardown always reclaiming the arena
+bit-for-bit (the no-refcount-leak law the chaos scenario asserts under
+load).
+
+The second half replays lookup/publish/evict/free interleavings under
+the seeded InterleaveScheduler harness (tests/test_racecheck.py
+pattern): a given seed reproduces the exact same schedule and the
+exact same sharing outcome forever, and the guarded-proxy test proves
+an unlocked touch of index state fails loudly instead of corrupting
+refcounts one run in a thousand. No engine, no JAX — this file is
+pure host-side allocator discipline.
+"""
+
+import random
+from collections import Counter
+
+import pytest
+
+from perceiver_tpu.serving.decode import PagePool
+from perceiver_tpu.serving.prefix_cache import (
+    PrefixCacheConfig,
+    PrefixIndex,
+    ensure_private_page,
+)
+from perceiver_tpu.utils.concurrency import (
+    InstrumentedLock,
+    InterleaveScheduler,
+    UnguardedAccessError,
+    guarded,
+)
+
+PS = 4  # page size for every test in this file
+
+
+def _ceil_pages(tokens):
+    return -(-tokens // PS)
+
+
+def _assert_invariants(pool, index=None):
+    """The laws that must hold after EVERY pool/index operation."""
+    # conservation: each non-reserved page is exactly one of free or
+    # allocated; page 0 never escapes the allocator
+    assert pool.free_pages + pool.allocated_pages == pool.num_pages - 1
+    assert 0 not in pool._allocated
+    # an allocated page always has at least one holder (refcounts can
+    # never be observed at <= 0 — the zero-crossing recycles the page)
+    for p in pool._allocated:
+        assert pool.refcount(p) >= 1
+    if index is not None:
+        # the trash page is never indexed; every indexed page is a
+        # live allocation (the index itself holds a reference)
+        assert 0 not in index._by_page
+        for p in index._by_page:
+            assert pool.refcount(p) >= 1
+        assert 0 <= index.evictable_pages() <= index.pages_indexed
+
+
+# --- PagePool refcount properties -------------------------------------------
+
+
+def test_pagepool_randomized_refcount_invariants():
+    """Random alloc/incref/free against a Counter model: the pool's
+    refcounts track the model exactly and never go negative."""
+    rng = random.Random(0xA11C)
+    pool = PagePool(num_pages=17, page_size=PS)
+    held = []  # one entry per outstanding reference
+    for _ in range(2000):
+        op = rng.random()
+        if op < 0.4 and pool.free_pages:
+            held.extend(pool.alloc(rng.randint(1, pool.free_pages)))
+        elif op < 0.7 and held:
+            p = rng.choice(held)
+            pool.incref([p])
+            held.append(p)
+        elif held:
+            pool.free([held.pop(rng.randrange(len(held)))])
+        _assert_invariants(pool)
+        model = Counter(held)
+        assert set(model) == pool._allocated
+        for p, c in model.items():
+            assert pool.refcount(p) == c
+    pool.free(held)
+    assert pool.allocated_pages == 0
+    assert pool.free_pages == pool.num_pages - 1
+
+
+def test_pagepool_over_free_and_foreign_free_raise():
+    pool = PagePool(num_pages=9, page_size=PS)
+    (page,) = pool.alloc(1)
+    pool.incref([page])
+    pool.free([page])
+    pool.free([page])  # last holder — recycles
+    with pytest.raises(ValueError, match="double-free or foreign"):
+        pool.free([page])
+    with pytest.raises(ValueError, match="double-free or foreign"):
+        pool.free([0])  # the trash page is never allocated
+
+
+def test_pagepool_incref_requires_allocation():
+    pool = PagePool(num_pages=9, page_size=PS)
+    with pytest.raises(ValueError, match="incref of unallocated"):
+        pool.incref([3])
+    with pytest.raises(ValueError, match="incref of unallocated"):
+        pool.incref([0])
+    (page,) = pool.alloc(1)
+    pool.incref([page])
+    assert pool.refcount(page) == 2
+
+
+def test_ensure_private_page_is_the_cow_guard():
+    pool = PagePool(num_pages=9, page_size=PS)
+    (page,) = pool.alloc(1)
+    assert ensure_private_page(pool, page) == page
+    pool.incref([page])  # now shared — writing would corrupt a peer
+    with pytest.raises(ValueError, match="copy-on-write violation"):
+        ensure_private_page(pool, page)
+    pool.free([page])
+    assert ensure_private_page(pool, page) == page
+    with pytest.raises(ValueError, match="trash page"):
+        ensure_private_page(pool, 0)
+
+
+# --- PrefixIndex properties -------------------------------------------------
+
+
+def test_prefix_cache_config_validates():
+    with pytest.raises(ValueError, match="max_pages"):
+        PrefixCacheConfig(max_pages=-1)
+    assert PrefixCacheConfig().max_pages is None
+    assert PrefixCacheConfig(max_pages=0).max_pages == 0
+
+
+def test_publish_refuses_trash_page():
+    pool = PagePool(num_pages=9, page_size=PS)
+    index = PrefixIndex(pool, PS)
+    with pytest.raises(ValueError, match="trash page 0"):
+        index.publish(list(range(PS)), [0])
+    assert index.pages_indexed == 0
+
+
+def test_contains_is_a_pure_query():
+    pool = PagePool(num_pages=9, page_size=PS)
+    index = PrefixIndex(pool, PS)
+    prompt = list(range(PS)) + [7]
+    pages = pool.alloc(1)
+    index.publish(prompt, pages)
+    rc = pool.refcount(pages[0])
+    assert index.contains(prompt) == PS
+    assert index.contains(prompt + [1, 2]) == PS
+    assert index.contains([9] * (PS + 1)) == 0
+    assert pool.refcount(pages[0]) == rc  # no incref, no LRU churn
+
+
+def test_lookup_never_returns_the_whole_prompt():
+    """The partial-last-page-is-private law: even a prompt whose every
+    page is cached keeps >= 1 tail token for private chunk prefill."""
+    pool = PagePool(num_pages=9, page_size=PS)
+    index = PrefixIndex(pool, PS)
+    prompt = list(range(2 * PS))
+    pages = pool.alloc(2)
+    index.publish(prompt, pages)
+    # identical prompt again: only the first page may be served
+    cached, shared = index.lookup(prompt)
+    assert cached == PS and len(shared) == 1
+    assert cached < len(prompt)
+    pool.free(shared)
+    pool.free(pages)
+    index.clear()
+    _assert_invariants(pool, index)
+
+
+def test_max_pages_trims_after_publish():
+    pool = PagePool(num_pages=17, page_size=PS)
+    index = PrefixIndex(pool, PS, PrefixCacheConfig(max_pages=2))
+    for tag in range(4):  # four distinct single-page prefixes
+        prompt = [tag] * PS + [tag]
+        pages = pool.alloc(1)
+        index.publish(prompt, pages)
+        pool.free(pages)  # stream finishes; index ref remains
+        _assert_invariants(pool, index)
+    assert index.pages_indexed == 2  # LRU-trimmed to the cap
+    assert index.clear() == 2
+    assert pool.free_pages == pool.num_pages - 1
+
+
+def test_prefix_index_randomized_sharing_invariants():
+    """The main property test: a random admission/publish/finish/evict
+    /clear workload over a 3-symbol alphabet (so prefixes really
+    collide) holds every invariant at every step, and full teardown
+    reclaims the arena exactly."""
+    for seed in (1, 7, 42):
+        rng = random.Random(seed)
+        pool = PagePool(num_pages=33, page_size=PS)
+        index = PrefixIndex(pool, PS)
+        streams = []  # (prompt, pages)
+        for _ in range(600):
+            op = rng.random()
+            if op < 0.45:
+                prompt = [rng.randrange(3)
+                          for _ in range(rng.randint(1, 14))]
+                cached, shared = index.lookup(prompt)
+                assert cached % PS == 0 and cached < len(prompt)
+                assert len(shared) == cached // PS
+                for p in shared:  # index + this stream hold it
+                    assert pool.refcount(p) >= 2
+                    with pytest.raises(ValueError):
+                        ensure_private_page(pool, p)
+                need = _ceil_pages(len(prompt) - cached)
+                budget = pool.free_pages + index.evictable_pages()
+                if need > budget:  # admission deferred: undo the hold
+                    if shared:
+                        pool.free(shared)
+                    continue
+                if need > pool.free_pages:
+                    index.evict(need - pool.free_pages)
+                private = pool.alloc(need)
+                for p in private:  # every writable page is private
+                    assert ensure_private_page(pool, p) == p
+                streams.append((prompt, shared + private))
+            elif op < 0.65 and streams:
+                prompt, pages = rng.choice(streams)
+                index.publish(prompt, pages)  # idempotent re-publish ok
+            elif op < 0.9 and streams:
+                prompt, pages = streams.pop(rng.randrange(len(streams)))
+                if rng.random() < 0.5:
+                    index.publish(prompt, pages)
+                pool.free(pages)  # uniform teardown decref
+            elif op < 0.97:
+                index.evict(rng.randint(1, 4))
+            else:
+                index.clear()
+            _assert_invariants(pool, index)
+        for _, pages in streams:
+            pool.free(pages)
+        index.clear()
+        assert pool.allocated_pages == 0
+        assert pool.free_pages == pool.num_pages - 1
+
+
+# --- seeded interleavings ---------------------------------------------------
+
+
+def _make_worker(name, pool, index, lock, prompts, log):
+    """One simulated admission loop: lookup under the lock, publish,
+    decode for a while (other workers interleave here), then the
+    uniform teardown decref. Mirrors the engine's critical sections."""
+
+    def run():
+        for prompt in prompts:
+            with lock:
+                cached, shared = index.lookup(prompt)
+                need = _ceil_pages(len(prompt) - cached)
+                if need > pool.free_pages:
+                    index.evict(need - pool.free_pages)
+                if need > pool.free_pages:
+                    if shared:
+                        pool.free(shared)
+                    log.append((name, tuple(prompt), "deferred"))
+                    continue
+                pages = shared + pool.alloc(need)
+                log.append((name, tuple(prompt), cached))
+            with lock:
+                index.publish(prompt, pages)
+            with lock:
+                pool.free(pages)
+
+    return run
+
+
+def _interleaved_run(seed):
+    pool = PagePool(num_pages=17, page_size=PS)
+    sched = InterleaveScheduler(seed=seed)
+    lock = InstrumentedLock(sched, name="engine._lock")
+    index = PrefixIndex(pool, PS)
+    log = []
+    shared_prefix = [9] * (2 * PS)
+    for w in range(3):  # all three race on the same 2-page prefix
+        prompts = [shared_prefix + [w, t] for t in range(3)]
+        sched.spawn(_make_worker(f"w{w}", pool, index, lock, prompts,
+                                 log), name=f"w{w}")
+    sched.run()
+    _assert_invariants(pool, index)
+    # every stream finished: only the index holds pages now
+    before = pool.allocated_pages
+    assert before == index.pages_indexed
+    assert index.clear() == before
+    assert pool.allocated_pages == 0
+    assert pool.free_pages == pool.num_pages - 1
+    return log, list(sched.trace)
+
+
+def test_interleaved_sharing_invariants_across_seeds():
+    """Nine workers' worth of contended lookup/publish/free schedules:
+    whatever the interleaving, the arena laws hold and at least one
+    late-arriving stream observes the shared prefix as a cache hit."""
+    for seed in (3, 11, 29, 54):
+        log, _trace = _interleaved_run(seed)
+        admitted = [e for e in log if e[2] != "deferred"]
+        assert admitted, log
+        # the prefix is 2 pages; once published, hits serve 2*PS tokens
+        assert any(e[2] == 2 * PS for e in admitted), log
+
+
+def test_interleaved_sharing_replays_are_bitwise():
+    """Seeded determinism: the same seed reproduces the exact same
+    schedule, the same hit pattern, and the same trace — a failure
+    under seed S is replayable forever."""
+    for seed in (3, 29):
+        log_a, trace_a = _interleaved_run(seed)
+        log_b, trace_b = _interleaved_run(seed)
+        assert log_a == log_b
+        assert trace_a == trace_b
+
+
+def test_unguarded_index_access_fails_loudly():
+    """The dynamic half of the _GUARDED_BY declaration: wrap the index
+    map in a guarded proxy and a lockless touch raises instead of
+    racing the refcount bookkeeping."""
+    sched = InterleaveScheduler(seed=5)
+    lock = InstrumentedLock(sched, name="engine._lock")
+    pool = PagePool(num_pages=9, page_size=PS)
+    index = PrefixIndex(pool, PS)
+    index._by_page = guarded(index._by_page, lock,
+                             "PrefixIndex._by_page")
+    outcomes = []
+
+    def bad():
+        try:
+            outcomes.append(("bad", index.pages_indexed))
+        except UnguardedAccessError as e:
+            outcomes.append(("bad", type(e).__name__))
+
+    def good():
+        with lock:
+            outcomes.append(("good", index.pages_indexed))
+
+    sched.spawn(bad, name="bad")
+    sched.spawn(good, name="good")
+    sched.run()
+    assert ("bad", "UnguardedAccessError") in outcomes
+    assert ("good", 0) in outcomes
+
+
+def test_guarded_declarations_match_engine_registry():
+    """The index is externally guarded by the engine lock, exactly
+    like the pool — and the engine's _GUARDED registry (what the
+    racecheck guarded-attrs pass keys on) says so."""
+    from perceiver_tpu.serving.decode import DecodeEngine
+
+    assert PrefixIndex._GUARDED_BY == "DecodeEngine._lock"
+    assert PagePool._GUARDED_BY == "DecodeEngine._lock"
+    assert DecodeEngine._GUARDED["prefix_index"] == "_lock"
+    assert DecodeEngine._GUARDED["pool"] == "_lock"
