@@ -657,7 +657,8 @@ PACKED_SERVING_TARGETS = (
 def _decode_batch_mlm(vocab: int = 10003, seq: int = 512,
                       channels: int = 64, streams: int = 8,
                       num_pages: int = 64, page_size: int = 16,
-                      max_chunk: int = 8, attn_impl: str = "pallas"):
+                      max_chunk: int = 8, attn_impl: str = "pallas",
+                      spec_k: int = 0):
     import jax.numpy as jnp
     import numpy as np
 
@@ -667,13 +668,21 @@ def _decode_batch_mlm(vocab: int = 10003, seq: int = 512,
     task = MaskedLanguageModelTask(
         vocab_size=vocab, max_seq_len=seq, num_latent_channels=channels)
     rng = np.random.default_rng(0)
-    # alternate prefill (full chunk) and decode (1 token) rows
-    qlens = np.array([max_chunk if i % 2 == 0 else 1
-                      for i in range(streams)], np.int32)
+    if spec_k:
+        # all three row phases of a speculative engine in one batch:
+        # prefill chunk / k+1-lane verify window / plain decode
+        pattern = (max_chunk, spec_k + 1, 1)
+        qlens = np.array([pattern[i % 3] for i in range(streams)],
+                         np.int32)
+    else:
+        # alternate prefill (full chunk) and decode (1 token) rows
+        qlens = np.array([max_chunk if i % 2 == 0 else 1
+                          for i in range(streams)], np.int32)
     return task, {
         "geometry": DecodeGeometry(
             max_streams=streams, num_pages=num_pages,
-            page_size=page_size, max_seq_len=seq, max_chunk=max_chunk),
+            page_size=page_size, max_seq_len=seq, max_chunk=max_chunk,
+            spec_k=spec_k),
         "tokens": jnp.asarray(
             rng.integers(3, vocab, (streams, max_chunk)), jnp.int32),
         "qlens": jnp.asarray(qlens),
@@ -689,9 +698,24 @@ def _decode_batch_mlm_spmd():
                              attn_impl="reference")
 
 
+def _decode_batch_mlm_spec():
+    # the speculative verify executable: k=4 drafted lanes + feedback
+    # fold 5 latent-rebuild windows per stream into the kernel row
+    # axis — the hbm pin certifies the widened step stays
+    # geometry-bound (same pools, W× latents only)
+    return _decode_batch_mlm(spec_k=4)
+
+
+def _decode_batch_mlm_spec_spmd():
+    return _decode_batch_mlm(vocab=8192, seq=256, num_pages=48,
+                             attn_impl="reference", spec_k=4)
+
+
 DECODE_TARGETS = (
     StepTarget(name="decode_mixed_mlm_r8_p64x16_q8",
                build=_decode_batch_mlm, kind="decode"),
+    StepTarget(name="decode_spec_mlm_r8_p64x16_q8_k4",
+               build=_decode_batch_mlm_spec, kind="decode"),
 )
 
 
@@ -744,6 +768,21 @@ SHARDED_TARGETS = (
                # kernel's fp32 online-softmax accumulator bit-for-bit
                # in tests — two QK^T and two PV dots per step (layer_1
                # + the scanned layer_n), ~9% of step dot-FLOPs each
+               dtype_allow=(
+                   DtypeAllow(
+                       dtype="f32", max_count=4,
+                       reason="reference paged-attention fp32 "
+                              "accumulation — parity twin of the "
+                              "Pallas kernel's fp32 online-softmax "
+                              "accumulator; production decode lowers "
+                              "the bf16 Pallas kernel instead"),)),
+    StepTarget(name="decode_spec_mlm_spmd_r8_p48x16_q8_k4_dp2_tp2",
+               build=_decode_batch_mlm_spec_spmd, kind="decode",
+               mesh=DP2_TP2,
+               replication_allow=_SPMD_MLM_EMBED_ALLOW,
+               # window tiling folds the k+1 verify lanes into the row
+               # axis of the SAME attention dots, so the fp32 count is
+               # unchanged from the non-speculative twin
                dtype_allow=(
                    DtypeAllow(
                        dtype="f32", max_count=4,

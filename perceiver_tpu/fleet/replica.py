@@ -140,6 +140,8 @@ class ReplicaServer:
         # step compile in the zero-compile spin-up budget
         self.decode_engine = None
         self._prefix_cache_cfg = None
+        self._spec_cfg = None
+        self._draft_version = None
         if spec.get("decode"):
             from perceiver_tpu.serving.decode import (
                 DecodeEngine,
@@ -162,11 +164,46 @@ class ReplicaServer:
             elif isinstance(pc, dict):
                 pc = PrefixCacheConfig(**pc)
             self._prefix_cache_cfg = pc
+            # opt-in speculative decoding (spec key "speculative";
+            # geometry's spec_k stays in dspec — it forks the compiled
+            # step). "draft" holds shrink_task overrides (absent =
+            # self-draft); "draft_version" names a separately
+            # published draft tree in the SAME version store.
+            sp = dspec.pop("speculative", None)
+            spec_cfg = None
+            self._draft_version = None
+            if sp:
+                from perceiver_tpu.serving.speculative import (
+                    SpeculativeConfig,
+                    shrink_task,
+                )
+
+                sp = dict(sp) if isinstance(sp, dict) else {}
+                self._draft_version = sp.pop("draft_version", None)
+                shrink = sp.pop("draft", None)
+                draft_task = None
+                if shrink is not None:
+                    draft_task = shrink_task(
+                        task, **(shrink if isinstance(shrink, dict)
+                                 else {}))
+                draft_params = None
+                if self._draft_version is not None:
+                    if self.store is None:
+                        raise ValueError(
+                            "speculative.draft_version needs a params "
+                            "version store (store_dir)")
+                    draft_params = self.store.load(
+                        self._draft_version, None)
+                spec_cfg = SpeculativeConfig(
+                    draft_task=draft_task, draft_params=draft_params,
+                    **sp)
+            self._spec_cfg = spec_cfg
             self.decode_engine = DecodeEngine(
                 task, self.engine._params_src,
                 geometry=DecodeGeometry(**dspec),
                 token_budget=token_budget,
                 prefix_cache=pc,
+                speculative=spec_cfg,
                 metrics=self.engine.metrics)
         self.server = RpcServer(self.handle,
                                 port=int(spec.get("port", 0)),
@@ -306,7 +343,31 @@ class ReplicaServer:
             "prefix_cache": (
                 {"max_pages": self._prefix_cache_cfg.max_pages}
                 if self._prefix_cache_cfg is not None else None),
+            # which replicas draft-and-verify, and from which tree
+            # (None = decode absent or speculation off)
+            "speculative": (
+                {"spec_k": self.decode_engine.geometry.spec_k,
+                 "self_draft": self._spec_cfg.draft_task is None,
+                 "draft_version": self._draft_version}
+                if self._spec_cfg is not None else None),
         }
+
+    def _load_draft_for(self, version: str):
+        """The draft tree riding along with ``version`` (two trees,
+        ONE cutover): a separately checkpointed draft is published as
+        ``<version>-draft`` in the same store. Returns None when this
+        replica doesn't draft from its own checkpoint — a self-draft
+        engine tracks the target tree inside ``update_params``.
+        Loading happens BEFORE either tree is swapped, so a corrupt
+        draft manifest aborts the whole cutover typed and the replica
+        keeps serving the old pair."""
+        if (self.decode_engine is None or self._spec_cfg is None
+                or self._spec_cfg.draft_task is None):
+            return None
+        draft_version = f"{version}-draft"
+        if draft_version not in self.store.versions():
+            return None
+        return self.store.load(draft_version, None)
 
     def _update_version(self, version: str) -> dict:
         """The cutover: quiesce → verify → swap → readmit."""
@@ -325,9 +386,13 @@ class ReplicaServer:
             # rollout driver turns it into an auto-rollback
             params = self.store.load(version,
                                      self.engine._params_src)
+            # both trees load before EITHER swaps: target and draft
+            # can never come from different versions mid-traffic
+            draft_params = self._load_draft_for(version)
             self.engine.update_params(params)
             if self.decode_engine is not None:
-                self.decode_engine.update_params(params)
+                self.decode_engine.update_params(
+                    params, draft_params=draft_params)
             self.version = version
         finally:
             with self._lock:
@@ -342,8 +407,11 @@ class ReplicaServer:
         if self.store is None:
             raise ValueError("replica has no params version store")
         params = self.store.load(version, self.engine._params_src)
+        # the draft tree stages alongside the target tree — a commit
+        # later swaps both inside one quiesced window
+        draft_params = self._load_draft_for(version)
         with self._lock:
-            self._staged = (version, params)
+            self._staged = (version, params, draft_params)
         return {"staged": version}
 
     def _commit_version(self, version: str) -> dict:
@@ -370,11 +438,12 @@ class ReplicaServer:
             with self._lock:
                 while self._inflight > 0:
                     self._idle.wait(0.05)
-                version, params = self._staged
+                version, params, draft_params = self._staged
                 self._staged = None
             self.engine.update_params(params)
             if self.decode_engine is not None:
-                self.decode_engine.update_params(params)
+                self.decode_engine.update_params(
+                    params, draft_params=draft_params)
             self.version = version
         finally:
             with self._lock:

@@ -85,6 +85,12 @@ SCHEMA: Dict[str, Tuple[str, ...]] = {
     "prefix_cache_miss": ("stream",),
     "prefix_cache_publish": ("stream", "pages"),
     "prefix_cache_evict": ("pages",),
+    # speculative decoding (serving/speculative.py): one verify event
+    # per speculative row per step ("drafted"/"accepted" token
+    # counts); spec_fallback when a stream's acceptance EMA collapses
+    # and the engine drops it back to plain decode for good.
+    "spec_verify": ("stream", "drafted", "accepted"),
+    "spec_fallback": ("stream", "acceptance"),
 }
 
 

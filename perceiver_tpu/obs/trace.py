@@ -69,6 +69,8 @@ PHASES = (
     "prefill_chunk",  # decode engine: one chunked-prefill slice of a prompt
     "token_emit",   # decode engine: one generated token handed out
     "prefix_lookup",  # decode engine: prefix-cache probe at admission
+    "draft",        # decode engine: draft-model proposal calls for one row
+    "verify",       # decode engine: target verification of drafted tokens
 )
 
 _enabled = True

@@ -269,6 +269,35 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, page_tables,
     return out.astype(q.dtype)
 
 
+def tile_for_windows(page_tables, kv_lens, windows: int):
+    """Tile ragged-paged operands so each row scores ``windows``
+    right-aligned KV prefixes in one kernel call.
+
+    Row ``r`` of the input becomes rows ``r*windows .. r*windows +
+    windows - 1`` of the output: window ``j`` replays row r's own page
+    walk against its first ``max(kv_lens[r] - (windows - 1 - j), 0)``
+    cached tokens, so window ``windows - 1`` sees the full cache (the
+    plain decode view) and window ``j`` hides the newest
+    ``windows - 1 - j`` tokens. Speculative verify
+    (serving/decode.py) is the consumer: after scattering a stream's
+    feedback token plus ``k`` drafted tokens in one chunk, the
+    target's prediction *at* drafted position ``i`` is exactly the
+    full-cache view minus the drafts from ``i`` on — so one ragged
+    call over the tiled rows scores every drafted position of every
+    stream. No pages are copied: only the table rows repeat and the
+    length vector fans out. Returns ``(page_tables, kv_lens)`` shaped
+    ``(R*windows, pages_per_stream)`` / ``(R*windows,)``.
+    """
+    if windows < 1:
+        raise ValueError(f"windows must be >= 1, got {windows}")
+    r = page_tables.shape[0]
+    tables = jnp.repeat(page_tables, windows, axis=0)
+    back = jnp.arange(windows - 1, -1, -1, dtype=jnp.int32)
+    lens = jnp.maximum(
+        kv_lens.astype(jnp.int32)[:, None] - back[None, :], 0)
+    return tables, lens.reshape(r * windows)
+
+
 def paged_decode_attention(q, k_pages, v_pages, page_tables, lengths, *,
                            scale: Optional[float] = None,
                            interpret: Optional[bool] = None):

@@ -11,6 +11,8 @@ Layers, bottom to top:
   continuous-batching scheduler (``ContinuousBatchScheduler``);
 - ``decode``  — autoregressive streaming generation: O(1) paged KV
   caching through one AOT-compiled stepped executable;
+- ``speculative`` — draft-model policy + the rejection rule the
+  decode engine runs when ``DecodeGeometry.spec_k > 0``;
 - ``errors``  — the typed failure vocabulary (``Unavailable``,
   ``BatchError``) every layer speaks (docs/RESILIENCE.md);
 - ``health``  — the health/readiness state machine the engine exports
@@ -41,6 +43,12 @@ from perceiver_tpu.serving.prefix_cache import (  # noqa: F401
     PrefixCacheConfig,
     PrefixIndex,
     ensure_private_page,
+)
+from perceiver_tpu.serving.speculative import (  # noqa: F401
+    SpeculativeConfig,
+    greedy_accept,
+    shrink_task,
+    speculative_accept,
 )
 from perceiver_tpu.serving.errors import (  # noqa: F401
     BatchError,

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import warnings
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -90,11 +91,16 @@ class _Server:
             token_budget = max(t for t, _ in engine.packed_buckets)
             if max_batch is None:
                 max_batch = max(r for _, r in engine.packed_buckets)
-            self.batcher: MicroBatcher = TokenBudgetBatcher(
-                self._run_batch, token_budget=token_budget,
-                cost_fn=self._payload_cost, max_requests=max_batch,
-                max_delay_ms=max_delay_ms, max_depth=max_depth,
-                metrics=self.metrics)
+            # the packed serve path keeps the facade's future/worker
+            # surface on purpose — the deprecation aims at new decode
+            # callers, not at this single-shot pipeline
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", DeprecationWarning)
+                self.batcher: MicroBatcher = TokenBudgetBatcher(
+                    self._run_batch, token_budget=token_budget,
+                    cost_fn=self._payload_cost, max_requests=max_batch,
+                    max_delay_ms=max_delay_ms, max_depth=max_depth,
+                    metrics=self.metrics)
         else:
             if max_batch is None:
                 max_batch = (engine.batch_buckets[-1]

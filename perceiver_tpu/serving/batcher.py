@@ -42,8 +42,9 @@ import collections
 import dataclasses
 import threading
 import time
+import warnings
 from concurrent.futures import Future
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from perceiver_tpu.obs import trace as trace_mod
 from perceiver_tpu.serving.errors import BatchError, ServingError, Unavailable
@@ -394,10 +395,36 @@ class ContinuousBatchScheduler:
         ``prefill_remaining``). Decode rows pre-spend ``decode_rows``
         tokens; rows the leftover cannot reach get 0 (they idle this
         step), except the head row, which always gets >= 1."""
+        _, chunks = self.plan_speculative(decode_rows, (),
+                                          prefill_remaining)
+        return chunks
+
+    def plan_speculative(self, decode_rows: int,
+                         spec_requests: Sequence[int],
+                         prefill_remaining: Sequence[int],
+                         ) -> Tuple[List[int], List[int]]:
+        """Speculative-aware budget split for one step.
+
+        Every decode row (speculative or not) pre-spends 1 token —
+        its guaranteed feedback lane. Each speculative row then
+        *requests* up to ``spec_requests[i]`` extra drafted lanes;
+        extras are granted FIFO from what the budget has left, so a
+        saturated step degrades speculation toward plain decode
+        instead of starving prefill completely. The remainder is
+        handed to prefilling rows exactly as :meth:`plan_chunks`
+        (which is the ``spec_requests=()`` special case). Returns
+        ``(grants, chunks)`` aligned with the two input sequences.
+        """
         budget = self.token_budget
         if budget is None:
-            budget = decode_rows + len(prefill_remaining) * self.max_chunk
+            budget = (decode_rows + sum(int(k) for k in spec_requests)
+                      + len(prefill_remaining) * self.max_chunk)
         left = max(0, budget - decode_rows)
+        grants: List[int] = []
+        for req in spec_requests:
+            g = min(int(req), left)
+            grants.append(g)
+            left -= g
         chunks: List[int] = []
         for i, rem in enumerate(prefill_remaining):
             c = min(int(rem), self.max_chunk, left)
@@ -405,7 +432,7 @@ class ContinuousBatchScheduler:
                 c = max(c, 1)
             chunks.append(c)
             left = max(0, left - c)
-        return chunks
+        return grants, chunks
 
     @property
     def depth(self) -> int:
@@ -488,6 +515,11 @@ class AdmissionQueue(ContinuousBatchScheduler):
     def __init__(self, *, max_depth: int = 64,
                  metrics: Optional[MetricsRegistry] = None,
                  clock: Callable[[], float] = time.monotonic):
+        warnings.warn(
+            "AdmissionQueue is deprecated; construct "
+            "ContinuousBatchScheduler directly (it also owns the "
+            "per-step prefill chunk budget)",
+            DeprecationWarning, stacklevel=2)
         super().__init__(max_depth=max_depth, metrics=metrics,
                          clock=clock)
 
@@ -536,6 +568,11 @@ class TokenBudgetBatcher(MicroBatcher):
                  clock: Callable[[], float] = time.monotonic):
         if token_budget < 1:
             raise ValueError("token_budget must be >= 1")
+        warnings.warn(
+            "TokenBudgetBatcher is deprecated; the budget rule lives "
+            "on ContinuousBatchScheduler (budget_admits) and the "
+            "decode path uses the unified scheduler directly",
+            DeprecationWarning, stacklevel=2)
         self.token_budget = token_budget
         self.cost_fn = cost_fn
         super().__init__(runner, max_batch=max_requests,

@@ -91,11 +91,15 @@ from perceiver_tpu.serving.engine import (
     resolve_exec_cache,
 )
 from perceiver_tpu.serving.errors import BatchError, Unavailable
-from perceiver_tpu.serving.metrics import MetricsRegistry
+from perceiver_tpu.serving.metrics import MetricsRegistry, PagePoolGauges
 from perceiver_tpu.serving.prefix_cache import (
     PrefixCacheConfig,
     PrefixIndex,
     ensure_private_page,
+)
+from perceiver_tpu.serving.speculative import (
+    SpeculativeConfig,
+    greedy_accept,
 )
 
 
@@ -112,6 +116,7 @@ class DecodeGeometry:
     max_seq_len: int        # cap on prompt + generated (position table)
     top_k: int = 3
     max_chunk: int = 8      # prompt tokens one prefill chunk may carry
+    spec_k: int = 0         # drafted tokens verified per step (0 = off)
 
     def __post_init__(self):
         if self.max_streams < 1:
@@ -131,6 +136,13 @@ class DecodeGeometry:
             raise ValueError(
                 f"max_chunk must be in [1, max_seq_len], got "
                 f"{self.max_chunk}")
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.spec_k and self.spec_k + 1 > self.max_chunk:
+            raise ValueError(
+                f"spec_k {self.spec_k} needs {self.spec_k + 1} chunk "
+                f"lanes (feedback + drafts) but max_chunk is "
+                f"{self.max_chunk}")
 
     @property
     def pages_per_stream(self) -> int:
@@ -147,8 +159,12 @@ class DecodeGeometry:
 
     @property
     def descriptor(self) -> str:
-        return (f"r{self.max_streams}_p{self.num_pages}x{self.page_size}"
+        # spec_k suffixes only when speculation is compiled in, so
+        # every pre-existing exec-cache key (and every pinned budget
+        # keyed on the descriptor) is byte-identical at spec_k == 0
+        base = (f"r{self.max_streams}_p{self.num_pages}x{self.page_size}"
                 f"_s{self.max_seq_len}_q{self.max_chunk}")
+        return f"{base}_k{self.spec_k}" if self.spec_k else base
 
 
 class PagePool:
@@ -305,6 +321,7 @@ def build_decode_graph(model, geometry: DecodeGeometry, *,
     from perceiver_tpu.ops.paged_attention import (
         paged_decode_attention,
         paged_decode_attention_reference,
+        tile_for_windows,
     )
 
     if attn_impl not in ("pallas", "reference"):
@@ -333,6 +350,11 @@ def build_decode_graph(model, geometry: DecodeGeometry, *,
     attn = (paged_decode_attention if attn_impl == "pallas"
             else paged_decode_attention_reference)
     q_chunk = geometry.max_chunk
+    # speculative verify widens the latent rebuild to W = spec_k + 1
+    # right-aligned KV windows per stream (spec_w == 1 is the plain
+    # path, kept literally unchanged so its lowering — and with it the
+    # exec-cache key and every pinned analysis budget — cannot drift)
+    spec_w = geometry.spec_k + 1
     # flat-gather index base for the per-stream page lookup (static)
     row_base = jnp.arange(r, dtype=jnp.int32) * pps
 
@@ -383,16 +405,30 @@ def build_decode_graph(model, geometry: DecodeGeometry, *,
 
         # 3. latents from scratch over the paged pools — mirrors
         # serving/graphs._packed_encoder_apply with the ragged kernel
-        # swapped for the paged one
+        # swapped for the paged one. Perceiver latents are NON-causal
+        # over the cache, so speculative verify cannot reuse one
+        # latent set for every drafted position: each of the W windows
+        # gets its OWN latent rebuild against a right-aligned KV
+        # prefix, folded into the kernel's row axis (no pages copied —
+        # tile_for_windows repeats table rows and fans the lengths
+        # out). Window W-1 sees the full cache, i.e. exactly the plain
+        # decode view.
+        if spec_w == 1:
+            ver_tables, ver_lens, rows = tables, new_lengths, r
+        else:
+            ver_tables, ver_lens = tile_for_windows(
+                tables, new_lengths, spec_w)
+            rows = r * spec_w
+
         def one_layer(layer_params, kpool, vpool, lat):
             attn_p = layer_params["cross"]["attn"]
             xq = layer_norm_apply(attn_p["norm_q"], lat, policy=policy)
             qh = linear_apply(attn_p["mha"]["q"], xq, policy=policy)
-            q = qh.reshape(r, n_lat, enc_heads, head_dim).transpose(
+            q = qh.reshape(rows, n_lat, enc_heads, head_dim).transpose(
                 0, 2, 1, 3)
-            o = attn(q, kpool, vpool, tables, new_lengths,
+            o = attn(q, kpool, vpool, ver_tables, ver_lens,
                      scale=1.0 / (head_dim ** 0.5))
-            o = o.transpose(0, 2, 1, 3).reshape(r, n_lat,
+            o = o.transpose(0, 2, 1, 3).reshape(rows, n_lat,
                                                 enc_heads * head_dim)
             o = linear_apply(attn_p["mha"]["out"], o, policy=policy)
             y = lat + o
@@ -405,7 +441,7 @@ def build_decode_graph(model, geometry: DecodeGeometry, *,
 
         latent = jnp.broadcast_to(
             policy.cast_param(enc_p["latent"])[None],
-            (r, n_lat, channels))
+            (rows, n_lat, channels))
         latent = one_layer(enc_p["layer_1"], kv["k1"], kv["v1"], latent)
         if n_layers > 1:
             layer_n = enc_p["layer_n"]
@@ -417,32 +453,53 @@ def build_decode_graph(model, geometry: DecodeGeometry, *,
             latent, _ = jax.lax.scan(body, latent, None,
                                      length=n_layers - 1)
 
-        # 4. decode ONE query row per stream: the next position
+        # 4. decode ONE query row per (stream × window): the window's
+        # next position — at spec_w == 1 this is the stream's next
+        # position, the plain contract
         pd = params["decoder"]
-        qpos = jnp.clip(new_lengths, 0, max_seq - 1)
+        qpos = jnp.clip(ver_lens, 0, max_seq - 1)
         query = jnp.take(policy.cast_param(pd["query"]), qpos,
-                         axis=0)[:, None, :]  # (R, 1, C)
+                         axis=0)[:, None, :]  # (rows, 1, C)
         hidden = cross_attention_layer_apply(
             pd["cross"], query, latent, num_heads=dec_heads,
             policy=policy)
         logits = linear_apply(pd["output_adapter"]["linear"], hidden,
-                              policy=policy)[:, 0]  # (R, V)
-        scores, topk_ids = jax.lax.top_k(
-            logits.astype(jnp.float32), geometry.top_k)
+                              policy=policy)[:, 0]  # (rows, V)
         carry_out = {"kv": kv, "lengths": new_lengths,
                      "page_tables": tables}
+        if spec_w == 1:
+            scores, topk_ids = jax.lax.top_k(
+                logits.astype(jnp.float32), geometry.top_k)
+            return carry_out, {
+                "next_token": topk_ids[:, 0].astype(jnp.int32),
+                "topk_ids": topk_ids.astype(jnp.int32),
+                "topk_scores": scores,
+            }
+        # per-window greedy picks ride the same top_k op as the plain
+        # path so tie-breaking is identical: spec_tokens[:, -1] is
+        # bit-for-bit the next_token a non-speculative step yields
+        logits32 = logits.astype(jnp.float32)
+        _, ids_w = jax.lax.top_k(logits32, 1)
+        spec_tokens = ids_w[:, 0].reshape(r, spec_w).astype(jnp.int32)
+        last = logits32.reshape(r, spec_w, -1)[:, spec_w - 1]
+        scores, topk_ids = jax.lax.top_k(last, geometry.top_k)
         return carry_out, {
             "next_token": topk_ids[:, 0].astype(jnp.int32),
             "topk_ids": topk_ids.astype(jnp.int32),
             "topk_scores": scores,
+            "spec_tokens": spec_tokens,
         }
 
+    output_names = ("next_token", "topk_ids", "topk_scores")
+    if spec_w > 1:
+        output_names += ("spec_tokens",)
     return DecodeGraph(
         model=model, fn=fn, geometry=geometry, policy=policy,
         pool_dtype=pool_dtype,
         num_kv_sets=1 if n_layers == 1 else 2,
         head_dim=head_dim, num_heads=enc_heads,
-        vocab_size=vocab if vocab is not None else -1)
+        vocab_size=vocab if vocab is not None else -1,
+        output_names=output_names)
 
 
 # --- streams -----------------------------------------------------------------
@@ -468,7 +525,8 @@ class _Stream:
                  "on_token", "ctx", "enqueued_at", "deadline", "slot",
                  "pages", "fed", "next_input", "generated", "tokens_q",
                  "done", "outcome", "error", "ttft_s", "submitted_at",
-                 "prefill_chunks", "cached_tokens", "shared_pages")
+                 "prefill_chunks", "cached_tokens", "shared_pages",
+                 "draft_pages", "draft_fed", "spec_on", "acc_ema")
 
     def __init__(self, sid, prompt, max_new, pages_needed, on_token,
                  ctx, now, deadline):
@@ -477,6 +535,10 @@ class _Stream:
         self.prefill_chunks = 0
         self.cached_tokens = 0   # prefix-cache hit span (page-aligned)
         self.shared_pages = 0    # leading table entries shared via the index
+        self.draft_pages: List[int] = []  # draft-arena pages (speculative)
+        self.draft_fed = 0       # known tokens committed to the draft cache
+        self.spec_on = False     # drafting this stream (may fall back)
+        self.acc_ema = 1.0       # acceptance-rate EMA (fallback trigger)
         self.prompt = prompt
         self.max_new = max_new
         self.pages_needed = pages_needed
@@ -565,6 +627,14 @@ class DecodeEngine:
         "params": "_lock",
         "pool": "_lock",
         "prefix_index": "_lock",
+        # speculative draft arena: its own pool / host mirrors / carry,
+        # mutated only from the same step critical sections
+        "_draft_carry": "_lock",
+        "_draft_params": "_lock",
+        "draft_pool": "_lock",
+        "_draft_tables": "_lock",
+        "_draft_lengths": "_lock",
+        "_draft_dirty": "_lock",
     }
 
     def __init__(self, task, params=None, *,
@@ -576,14 +646,23 @@ class DecodeEngine:
                  max_queue: int = 64,
                  token_budget: Optional[int] = None,
                  prefix_cache: Optional[PrefixCacheConfig] = None,
+                 speculative: Optional[SpeculativeConfig] = None,
                  auto_step: bool = True,
                  seed: int = 0):
         import jax
         import jax.numpy as jnp
 
+        if (geometry.spec_k > 0) != (speculative is not None):
+            raise ValueError(
+                "speculative decoding needs both halves: geometry."
+                f"spec_k (got {geometry.spec_k}) compiles the verify "
+                "windows, speculative= (got "
+                f"{'a config' if speculative is not None else 'None'}) "
+                "supplies the draft policy")
         self.task = task
         self.geometry = geometry
         self.policy = policy
+        self.speculative = speculative
         # per-step token pacing: every decode row costs 1, the rest
         # goes to prefill chunks — host-side policy only, never a
         # compiled shape, so it is tunable without a recompile
@@ -638,6 +717,19 @@ class DecodeEngine:
         self._m_prefix_pages = m.gauge(
             "serving_prefix_cache_pages",
             "pages currently held by the prefix index")
+        self._m_spec_draft = m.counter(
+            "serving_spec_draft_tokens_total",
+            "draft-model tokens proposed for verification")
+        self._m_spec_accepted = m.counter(
+            "serving_spec_accepted_tokens_total",
+            "drafted tokens the target accepted")
+        self._m_spec_verify = m.counter(
+            "serving_spec_verify_steps_total",
+            "unified steps that verified at least one drafted window")
+        self._m_spec_fallback = m.counter(
+            "serving_spec_fallback_total",
+            "streams dropped to plain decode on acceptance collapse")
+        self._m_pool_gauges = PagePoolGauges(m, arena="target")
 
         r = geometry.max_streams
         self.pool = PagePool(geometry.num_pages, geometry.page_size)
@@ -649,6 +741,7 @@ class DecodeEngine:
             PrefixIndex(self.pool, geometry.page_size, prefix_cache)
             if prefix_cache is not None else None)
         self._m_free_pages.set(self.pool.free_pages)
+        self._m_pool_gauges.update(self.pool)
         self._queue = ContinuousBatchScheduler(
             max_depth=max_queue, token_budget=self.token_budget,
             max_chunk=geometry.max_chunk, metrics=m)
@@ -683,11 +776,89 @@ class DecodeEngine:
         np.asarray(out["next_token"])
         self._carry = carry
 
+        # speculative draft arena: a second (smaller) stepped
+        # executable with its OWN paged pool, page tables, lengths and
+        # carry — never shared with the target, because the draft's
+        # cache trails/leads the target's by design and prefix-shared
+        # target pages must not see draft writes
+        self._draft_graph = None
+        self._draft_exe = None
+        self._draft_carry = None
+        self._draft_params = None
+        self.draft_pool: Optional[PagePool] = None
+        self._draft_tables: Optional[np.ndarray] = None
+        self._draft_lengths: Optional[np.ndarray] = None
+        self._draft_dirty = False
+        self._m_draft_gauges: Optional[PagePoolGauges] = None
+        if speculative is not None:
+            self._init_draft(speculative, attn_impl)
+
         self._worker: Optional[threading.Thread] = None
         if auto_step:
             self._worker = threading.Thread(
                 target=self._loop, name="decode-engine", daemon=True)
             self._worker.start()
+
+    def _init_draft(self, spec: SpeculativeConfig,
+                    attn_impl: str) -> None:
+        """Build and warm the draft stepped executable (called from
+        ``__init__`` only; the lock is uncontended pre-publication but
+        taken anyway so the draft-state discipline holds uniformly)."""
+        with self._lock:
+            self._init_draft_locked(spec, attn_impl)
+
+    def _init_draft_locked(self, spec: SpeculativeConfig,
+                           attn_impl: str) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        g = self.geometry
+        # the draft never verifies — it decodes plain, one stream of
+        # proposals at a time — so its graph compiles at spec_k == 0
+        draft_geometry = dataclasses.replace(g, spec_k=0)
+        draft_task = (spec.draft_task if spec.draft_task is not None
+                      else self.task)
+        self._draft_graph = build_decode_graph(
+            draft_task.build(), draft_geometry, policy=self.policy,
+            attn_impl=attn_impl)
+        if self._draft_graph.vocab_size != self.graph.vocab_size:
+            raise ValueError(
+                f"draft vocab {self._draft_graph.vocab_size} != target "
+                f"vocab {self.graph.vocab_size} — proposals would not "
+                "be target token ids")
+        if spec.draft_params is not None:
+            self._draft_params = jax.device_put(spec.draft_params)
+        elif spec.draft_task is None:
+            self._draft_params = self.params  # self-draft
+        else:
+            self._draft_params = self._draft_graph.init_params(
+                spec.draft_seed)
+        self.draft_pool = PagePool(g.num_pages, g.page_size)
+        r = g.max_streams
+        self._draft_tables = np.zeros((r, g.pages_per_stream), np.int32)
+        self._draft_lengths = np.zeros((r,), np.int32)
+        self._draft_dirty = False
+        self._m_draft_gauges = PagePoolGauges(self.metrics, arena="draft")
+        self._m_draft_gauges.update(self.draft_pool)
+        tokens0 = jnp.zeros((r, g.max_chunk), jnp.int32)
+        qlens0 = jnp.zeros((r,), jnp.int32)
+        jitted = jax.jit(self._draft_graph.fn,
+                         donate_argnums=self._draft_graph.donate_argnums)
+        carry = self._draft_graph.init_carry()
+        self._draft_exe, info = aot_compile(
+            jitted, (self._draft_params, carry, tokens0, qlens0),
+            cache=self.exec_cache,
+            donate_argnums=self._draft_graph.donate_argnums,
+            label=f"draft:{g.descriptor}",
+            extra_key=("draft", g.descriptor))
+        if self.exec_cache is not None:
+            events_mod.emit("exec_cache",
+                            bucket=f"draft:{g.descriptor}",
+                            hit=bool(info["hit"]))
+        carry, out = self._draft_exe(
+            self._draft_params, carry, tokens0, qlens0)
+        np.asarray(out["next_token"])
+        self._draft_carry = carry
 
     # -- submission -------------------------------------------------------
 
@@ -808,6 +979,24 @@ class DecodeEngine:
                 ensure_private_page(self.pool, p)
             stream.pages = shared + private
             stream.fed = stream.cached_tokens
+            if self.draft_pool is not None:
+                # the draft arena has no prefix sharing (its cache is
+                # private per stream) and no eviction — when it can't
+                # host the stream, the stream just decodes plain
+                if stream.pages_needed <= self.draft_pool.free_pages:
+                    stream.draft_pages = self.draft_pool.alloc(
+                        stream.pages_needed)
+                    stream.spec_on = True
+                    stream.draft_fed = 0
+                    stream.acc_ema = 1.0
+                    self._draft_tables[slot, :] = 0
+                    self._draft_tables[slot, :len(stream.draft_pages)] \
+                        = stream.draft_pages
+                    self._draft_lengths[slot] = 0
+                    self._draft_dirty = True
+                else:
+                    stream.spec_on = False
+                self._m_draft_gauges.update(self.draft_pool)
             self._streams[slot] = stream
             self._tables[slot, :] = 0
             self._tables[slot, :len(stream.pages)] = stream.pages
@@ -826,6 +1015,7 @@ class DecodeEngine:
             self._m_active.set(
                 sum(1 for s in self._streams if s is not None))
             self._m_free_pages.set(self.pool.free_pages)
+            self._m_pool_gauges.update(self.pool)
         if self.prefix_index is not None:
             self._m_prefix_pages.set(self.prefix_index.pages_indexed)
 
@@ -855,14 +1045,37 @@ class DecodeEngine:
             prefill_live = sorted(
                 ((i, s) for i, s in live if s.fed < len(s.prompt)),
                 key=lambda e: e[1].seq)  # FIFO by admission order
-            plan = self._queue.plan_chunks(
-                len(decode_live),
+            # speculative candidates: drafting streams far enough from
+            # max_new that accepted drafts can't overshoot (the last
+            # verify window's bonus token is the +1)
+            spec_cand: List[tuple] = []
+            desires: List[int] = []
+            if self.speculative is not None:
+                for i, s in decode_live:
+                    kd = min(self.geometry.spec_k,
+                             s.max_new - len(s.generated) - 1)
+                    if s.spec_on and kd >= 1:
+                        spec_cand.append((i, s))
+                        desires.append(kd)
+            grants, plan = self._queue.plan_speculative(
+                len(decode_live), desires,
                 [len(s.prompt) - s.fed for _, s in prefill_live])
+            props: Dict[int, List[int]] = {}
+            if spec_cand:
+                cand = [(i, s, k) for (i, s), k in zip(spec_cand, grants)
+                        if k > 0]
+                if cand:
+                    props = self._draft_propose_locked(cand)
             tokens = np.zeros((r, self.geometry.max_chunk), np.int32)
             qlens = np.zeros((r,), np.int32)
             for i, s in decode_live:
                 tokens[i, 0] = s.next_input
-                qlens[i] = 1
+                p = props.get(i)
+                if p:
+                    # verify lanes: feedback token + the drafted run —
+                    # one chunk row, exactly like a prefill chunk
+                    tokens[i, 1:1 + len(p)] = p
+                qlens[i] = 1 + (len(p) if p else 0)
             chunks: Dict[int, int] = {}
             for (i, s), c in zip(prefill_live, plan):
                 chunks[i] = c
@@ -881,11 +1094,14 @@ class DecodeEngine:
                                        jnp.asarray(qlens))
                 # the one deliberate sync of the decode path
                 next_tok = np.asarray(out["next_token"])
+                spec_tok = (np.asarray(out["spec_tokens"])
+                            if props else None)
             except Exception as e:
                 self._fail_locked(e)
                 raise
             t1 = time.monotonic()
             self._carry = carry
+            lengths_before = self._lengths.copy() if props else None
             self._lengths += qlens
             self._m_steps.inc()
             self._m_step_latency.observe(t1 - t0)
@@ -920,23 +1136,30 @@ class DecodeEngine:
                                             stream=s.sid, pages=pub)
                         self._m_prefix_pages.set(
                             self.prefix_index.pages_indexed)
+                    emitted = [int(next_tok[i])]
                 else:
-                    s.fed += 1
+                    p = props.get(i)
+                    if p:
+                        emitted = self._verify_row_locked(
+                            i, s, p, spec_tok, lengths_before, t0, t1)
+                    else:
+                        s.fed += 1
+                        emitted = [int(next_tok[i])]
+                        if s.ctx is not None:
+                            s.ctx.record("decode_step", start=t0,
+                                         end=t1, stream=s.sid)
+                for tok in emitted:
+                    s.generated.append(tok)
+                    if s.ttft_s is None:
+                        s.ttft_s = t1 - s.submitted_at
+                        self._m_ttft.observe(s.ttft_s)
                     if s.ctx is not None:
-                        s.ctx.record("decode_step", start=t0, end=t1,
-                                     stream=s.sid)
-                tok = int(next_tok[i])
-                s.generated.append(tok)
-                s.next_input = tok
-                if s.ttft_s is None:
-                    s.ttft_s = t1 - s.submitted_at
-                    self._m_ttft.observe(s.ttft_s)
-                if s.ctx is not None:
-                    s.ctx.record("token_emit", start=t1, end=t1,
-                                 stream=s.sid,
-                                 index=len(s.generated) - 1)
-                self._m_tokens.inc()
-                emits.append((s, tok))
+                        s.ctx.record("token_emit", start=t1, end=t1,
+                                     stream=s.sid,
+                                     index=len(s.generated) - 1)
+                    self._m_tokens.inc()
+                    emits.append((s, tok))
+                s.next_input = emitted[-1]
                 if len(s.generated) >= s.max_new:
                     self._finish_locked(s, "complete")
                     finished.append(s)
@@ -952,6 +1175,147 @@ class DecodeEngine:
             s.tokens_q.put(_SENTINEL)
             s.done.set()
         return len(live)
+
+    def _draft_propose_locked(self, cand) -> Dict[int, List[int]]:
+        """Run up to ``spec_k + 1`` draft-model calls proposing tokens
+        for the granted decode rows (``cand``: (slot, stream, grant)).
+
+        The draft's cache is fed each stream's *known* tokens (prompt
+        + generated) — independent of the target's prefill progress or
+        prefix-cache hits, which is what keeps warm-prefix admissions
+        token-exact — then extended one proposal at a time through its
+        own stepped executable. ``stream.draft_fed`` tracks the known
+        prefix already cached; the call that consumes the last known
+        token yields the first proposal. A row still catching up when
+        the call cap runs out simply decodes plain this step and
+        resumes next cycle, so a long prompt can never stall its
+        neighbours' verify round.
+        """
+        import jax.numpy as jnp
+
+        g = self.geometry
+        props: Dict[int, List[int]] = {i: [] for i, _, _ in cand}
+        t_d0 = time.monotonic()
+        for _ in range(g.spec_k + 1):
+            tokens = np.zeros((g.max_streams, g.max_chunk), np.int32)
+            qlens = np.zeros((g.max_streams,), np.int32)
+            yields: List[int] = []  # rows whose call emits a proposal
+            for i, s, grant in cand:
+                known = len(s.prompt) + len(s.generated)
+                if len(props[i]) >= grant:
+                    continue
+                if s.draft_fed >= known and not props[i]:
+                    # defensive: every known token cached but no
+                    # proposal in hand — rewind one and refeed it (the
+                    # rewritten KV is identical, only the length moves)
+                    s.draft_fed = known - 1
+                    self._draft_lengths[i] = known - 1
+                    self._draft_dirty = True
+                if s.draft_fed < known:
+                    feed = min(known - s.draft_fed, g.max_chunk)
+                    base = len(s.prompt)
+                    for j in range(feed):
+                        t = s.draft_fed + j
+                        tokens[i, j] = (s.prompt[t] if t < base
+                                        else s.generated[t - base])
+                    qlens[i] = feed
+                    if s.draft_fed + feed == known:
+                        yields.append(i)
+                else:
+                    tokens[i, 0] = props[i][-1]
+                    qlens[i] = 1
+                    yields.append(i)
+            if not qlens.any():
+                break
+            carry = self._draft_carry
+            self._draft_carry = None  # donated: loud on re-entry
+            if self._draft_dirty:
+                carry["page_tables"] = jnp.asarray(self._draft_tables)
+                carry["lengths"] = jnp.asarray(self._draft_lengths)
+                self._draft_dirty = False
+            try:
+                carry, out = self._draft_exe(
+                    self._draft_params, carry, jnp.asarray(tokens),
+                    jnp.asarray(qlens))
+                next_tok = np.asarray(out["next_token"])
+            except Exception as e:
+                self._fail_locked(e)
+                raise
+            self._draft_carry = carry
+            self._draft_lengths += qlens
+            for i, s, grant in cand:
+                if qlens[i]:
+                    # known prefix only — proposal feeds don't advance
+                    s.draft_fed = min(
+                        len(s.prompt) + len(s.generated),
+                        s.draft_fed + int(qlens[i]))
+            for i in yields:
+                props[i].append(int(next_tok[i]))
+        t_d1 = time.monotonic()
+        for i, s, _ in cand:
+            if props[i] and s.ctx is not None:
+                s.ctx.record("draft", start=t_d0, end=t_d1,
+                             stream=s.sid, tokens=len(props[i]))
+        return props
+
+    def _verify_row_locked(self, i: int, s: _Stream, p: List[int],
+                           spec_tok: np.ndarray,
+                           lengths_before: np.ndarray,
+                           t0: float, t1: float) -> List[int]:
+        """Apply the greedy rejection rule to one verified row and
+        roll both arenas back past the first disagreement. Returns the
+        tokens to emit (``accepted + 1``, never 0)."""
+        kg = len(p)
+        w = self.geometry.spec_k + 1
+        # window w-1-kg+j is the target's greedy pick AT drafted
+        # position j (conditioned on the drafts before it); the last
+        # window is the full-cache view — the bonus token
+        target_preds = [int(t) for t in spec_tok[i, w - 1 - kg:]]
+        a, nxt = greedy_accept(p, target_preds)
+        emitted = p[:a] + [int(nxt)]
+        # target arena: the step cached feedback + kg drafts; keep
+        # feedback + the accepted run. Rejected tails are masked by
+        # kv_len immediately and overwritten by later writes, and they
+        # only ever landed in refcount-1 private pages (drafted
+        # positions are past the prompt), so shared CoW prefix pages
+        # are untouched by construction.
+        c0 = int(lengths_before[i])
+        if a < kg:
+            self._lengths[i] = c0 + 1 + a
+            self._dirty = True
+        # draft arena: its cache holds known + kg-1 proposals; keep
+        # the prefix that is now confirmed known-correct
+        keep = len(s.prompt) + len(s.generated) + min(a, kg - 1)
+        if int(self._draft_lengths[i]) != keep:
+            self._draft_lengths[i] = keep
+            self._draft_dirty = True
+        s.draft_fed = keep
+        s.fed += 1 + a
+        s.acc_ema = (self.speculative.ema_alpha * (a / kg)
+                     + (1.0 - self.speculative.ema_alpha) * s.acc_ema)
+        self._m_spec_draft.inc(kg)
+        self._m_spec_accepted.inc(a)
+        self._m_spec_verify.inc()
+        events_mod.emit("spec_verify", stream=s.sid, drafted=kg,
+                        accepted=a)
+        if s.ctx is not None:
+            s.ctx.record("verify", start=t0, end=t1, stream=s.sid,
+                         drafted=kg, accepted=a)
+        if s.acc_ema < self.speculative.fallback_acceptance:
+            # acceptance collapsed: drafted tokens cost real step
+            # budget, so flip this stream to plain decode for good
+            # and hand its draft pages back
+            s.spec_on = False
+            self.draft_pool.free(s.draft_pages)
+            s.draft_pages = []
+            self._draft_tables[i, :] = 0
+            self._draft_lengths[i] = 0
+            self._draft_dirty = True
+            self._m_spec_fallback.inc()
+            self._m_draft_gauges.update(self.draft_pool)
+            events_mod.emit("spec_fallback", stream=s.sid,
+                            acceptance=round(s.acc_ema, 4))
+        return emitted
 
     def run_until_idle(self, max_steps: int = 10_000) -> int:
         """Step until no stream is active or queued (deterministic
@@ -987,9 +1351,17 @@ class DecodeEngine:
             self._tables[s.slot, :] = 0
             self._lengths[s.slot] = 0
             self._dirty = True
+            if s.draft_pages:
+                self.draft_pool.free(s.draft_pages)
+                s.draft_pages = []
+                self._draft_tables[s.slot, :] = 0
+                self._draft_lengths[s.slot] = 0
+                self._draft_dirty = True
+                self._m_draft_gauges.update(self.draft_pool)
             self._m_active.set(
                 sum(1 for st in self._streams if st is not None))
             self._m_free_pages.set(self.pool.free_pages)
+            self._m_pool_gauges.update(self.pool)
         events_mod.emit("stream_close", stream=s.sid,
                         tokens=len(s.generated))
         self._m_streams.labels(outcome=how).inc()
@@ -1037,18 +1409,30 @@ class DecodeEngine:
             s.done.set()
         self._work.notify_all()
 
-    def update_params(self, params) -> None:
+    def update_params(self, params, draft_params=None) -> None:
         """Swap weights recompile-free — same treedef/shapes → same
         compiled step. Callers quiesce first (the replica cutover's
         inflight guard covers decode dispatches end-to-end); a stream
         admitted after the swap generates entirely under the new tree,
         so no stream ever mixes KV from two versions. Cached prefix
         pages are a function of the weights, so the prefix index is
-        flushed here — a retained cache would serve stale KV."""
+        flushed here — a retained cache would serve stale KV.
+
+        Under speculative decoding the draft tree swaps in the same
+        critical section (the fleet cutover loads BOTH trees before
+        calling, so target and draft can never be from different
+        versions mid-traffic): pass ``draft_params`` for a separately
+        checkpointed draft; a self-drafting engine tracks ``params``
+        automatically; otherwise the draft tree is left alone."""
         import jax
 
         with self._lock:
             self.params = jax.device_put(params)
+            if self.speculative is not None:
+                if draft_params is not None:
+                    self._draft_params = jax.device_put(draft_params)
+                elif self.speculative.draft_task is None:
+                    self._draft_params = self.params  # self-draft
             if self.prefix_index is not None:
                 self.prefix_index.clear()
                 self._m_prefix_pages.set(0)
@@ -1064,6 +1448,7 @@ class DecodeEngine:
             released = self.prefix_index.clear()
             self._m_prefix_pages.set(0)
             self._m_free_pages.set(self.pool.free_pages)
+            self._m_pool_gauges.update(self.pool)
             return released
 
     def prefix_cache_stats(self) -> Optional[Dict[str, int]]:
@@ -1078,6 +1463,23 @@ class DecodeEngine:
                 "misses": int(self._m_prefix_misses.value_of()),
                 "hit_tokens": int(self._m_prefix_hit_tokens.value_of()),
                 "evicted_pages": int(self._m_prefix_evicted.value_of()),
+            }
+
+    def speculative_stats(self) -> Optional[Dict[str, float]]:
+        """Point-in-time speculative accounting (None when off)."""
+        with self._lock:
+            if self.speculative is None:
+                return None
+            drafted = self._m_spec_draft.value_of()
+            accepted = self._m_spec_accepted.value_of()
+            return {
+                "drafted_tokens": int(drafted),
+                "accepted_tokens": int(accepted),
+                "verify_steps": int(self._m_spec_verify.value_of()),
+                "fallbacks": int(self._m_spec_fallback.value_of()),
+                "acceptance_rate": (accepted / drafted) if drafted
+                else 0.0,
+                "draft_free_pages": self.draft_pool.free_pages,
             }
 
     @property

@@ -221,6 +221,37 @@ class _GaugeChild:
         self._parent._remove_child(self._key)
 
 
+class PagePoolGauges:
+    """Occupancy pair for one paged KV arena: used/free page gauges.
+
+    The decode engine owns one per arena (the target pool and, under
+    speculative decoding, the draft pool — told apart by the ``arena``
+    label), and calls :meth:`update` from the same critical sections
+    that mutate the pool, so the exposition can never show a
+    used/free pair that sums past the arena size. Exported through
+    fleet aggregation like every other engine metric (the replica's
+    registry render is scraped verbatim).
+    """
+
+    USED = "serving_page_pool_used_pages"
+    FREE = "serving_page_pool_free_pages"
+
+    def __init__(self, registry: "MetricsRegistry", *,
+                 arena: str = "target"):
+        self.arena = arena
+        used = registry.gauge(
+            self.USED, "decode-arena pages currently allocated, by arena")
+        free = registry.gauge(
+            self.FREE, "decode-arena pages on the free list, by arena")
+        self._used = used.labels(arena=arena)
+        self._free = free.labels(arena=arena)
+
+    def update(self, pool) -> None:
+        """Snapshot one :class:`~perceiver_tpu.serving.decode.PagePool`."""
+        self._used.set(pool.allocated_pages)
+        self._free.set(pool.free_pages)
+
+
 @guarded_by("_lock", "_counts", "_sum", "_count", "_reservoir",
             "_reservoir_n")
 class Histogram:

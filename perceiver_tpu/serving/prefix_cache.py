@@ -51,6 +51,15 @@ Design invariants (docs/SERVING.md#prefix-caching spells these out):
   evictable, leaf-first, least-recently-hit first. The engine admits
   against ``pool.free_pages + index.evictable_pages()`` so a full
   index never starves admission.
+- **Speculative rollback never touches shared pages.** Draft-proposed
+  tokens are generated tokens, so their KV lands at positions
+  ``>= len(prompt)`` — always in refcount-1 private pages by the
+  prompt-only publication rule above. When the verify step rejects a
+  draft suffix, the engine rewinds host lengths and truncates the page
+  table; the pages it releases are exactly those private tail pages,
+  so rollback composes with copy-on-write sharing without ever
+  mutating or freeing a published page (docs/SERVING.md "Speculative
+  decoding").
 """
 
 from __future__ import annotations

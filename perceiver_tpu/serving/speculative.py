@@ -1,0 +1,201 @@
+"""Speculative decoding: draft-model config + the rejection rule.
+
+The other half of ROADMAP item 2's decode line (r18 landed prefix
+caching; this module spends the freed compute): a small *draft* model
+proposes ``k`` tokens per in-flight stream, and the target model
+scores every stream's ``k+1``-token window in ONE step of the same
+ragged-paged stepped executable (``serving/decode.py`` — each
+speculative row is just a chunk row with ``query_len = k+1``).
+
+This module is the pure host-side half — everything here is numpy,
+so the acceptance math is unit-testable (including the chi-square
+distribution-match property test) without a device or a compile:
+
+- :class:`SpeculativeConfig` — what the engine needs to build and
+  drive the draft: the draft task (``None`` = self-draft on the
+  target's own config/params), its params/seed, and the per-stream
+  acceptance-collapse fallback policy.
+- :func:`shrink_task` — the canonical draft recipe: the SAME task
+  config with a shrunk latent stack (fewer latents / encoder
+  layers), so target and draft share tokenizer, vocab, and position
+  table by construction. Draft params are published separately in
+  the :class:`~perceiver_tpu.training.checkpoint.ParamsVersionStore`
+  (the fleet cutover stages both trees before swapping either).
+- :func:`speculative_accept` — the standard rejection rule (Leviathan
+  et al.; Chen et al.): accept draft token ``d_i`` with probability
+  ``min(1, p_i(d_i) / q_i(d_i))``; on the first rejection resample
+  from the residual ``max(p_i - q_i, 0)`` renormalized; when every
+  draft token survives, sample one *bonus* token from the target's
+  ``k+1``-th distribution. Every step therefore emits at least one
+  token, and the emitted sequence is distributed EXACTLY as sampling
+  the target alone — any draft, however bad, only costs speed.
+- :func:`greedy_accept` — the argmax degeneration the engine runs
+  (the decode engine is greedy end-to-end): with one-hot ``p``/``q``
+  the rule above reduces to "accept while the draft token equals the
+  target's argmax, then emit the target's argmax at the first
+  mismatch (or the bonus position)" — which makes greedy speculative
+  decode token-exact against non-speculative decode by construction.
+
+KV rollback for rejected tokens is the engine's job (host-side length
+rewind over the paged arena; shared copy-on-write prefix pages are
+never written by speculative rows because drafted positions always
+land past the prompt, i.e. in refcount-1 private pages — see
+docs/SERVING.md "Speculative decoding").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "SpeculativeConfig",
+    "shrink_task",
+    "greedy_accept",
+    "speculative_accept",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """Host-side speculative policy for a :class:`DecodeEngine`.
+
+    ``draft_task`` is a task whose model shares the target's vocab
+    and max_seq_len (``None`` = self-draft: the target's own task —
+    the bench's acceptance-rate-1.0 control arm). ``draft_params``
+    of ``None`` means: the target's params when self-drafting, else
+    a fresh init from ``draft_seed``. The geometry's ``spec_k``
+    (compiled window count) stays on
+    :class:`~perceiver_tpu.serving.decode.DecodeGeometry` because it
+    forks the exec-cache key; everything here is swappable without a
+    recompile.
+
+    ``fallback_acceptance``: when a stream's acceptance-rate EMA
+    (weight ``ema_alpha`` on the newest verify) drops below this, the
+    engine permanently flips the stream to plain decode and frees its
+    draft pages — drafted tokens cost real step budget, so a stream
+    the draft cannot predict must not tax its neighbours.
+    """
+
+    draft_task: Optional[object] = None
+    draft_params: Optional[object] = None
+    draft_seed: int = 0
+    fallback_acceptance: float = 0.2
+    ema_alpha: float = 0.4
+
+    def __post_init__(self):
+        if not 0.0 <= self.fallback_acceptance <= 1.0:
+            raise ValueError(
+                f"fallback_acceptance must be in [0, 1], got "
+                f"{self.fallback_acceptance}")
+        if not 0.0 < self.ema_alpha <= 1.0:
+            raise ValueError(
+                f"ema_alpha must be in (0, 1], got {self.ema_alpha}")
+
+
+def shrink_task(task, *, num_latents: Optional[int] = None,
+                num_encoder_layers: int = 1,
+                self_attention_layers_per_block: int = 1):
+    """The canonical draft recipe: ``task`` with a shrunk latent stack.
+
+    Keeps vocab, max_seq_len, channel width, and head counts (channel
+    divisibility is the target's own constraint, so the clone can
+    never violate it); shrinks the latent array and the encoder depth
+    — the two axes latent-rebuild cost scales with in a Perceiver
+    decode step. Defaults: quarter the latents (min 1), one encoder
+    layer, one self-attention layer per block.
+    """
+    if num_latents is None:
+        num_latents = max(1, task.num_latents // 4)
+    if num_latents < 1:
+        raise ValueError(f"num_latents must be >= 1, got {num_latents}")
+    if num_encoder_layers < 1:
+        raise ValueError(
+            f"num_encoder_layers must be >= 1, got {num_encoder_layers}")
+    return dataclasses.replace(
+        task, num_latents=num_latents,
+        num_encoder_layers=num_encoder_layers,
+        num_encoder_self_attention_layers_per_block=(
+            self_attention_layers_per_block))
+
+
+def greedy_accept(draft_tokens: Sequence[int],
+                  target_tokens: Sequence[int]) -> Tuple[int, int]:
+    """Greedy rejection rule over per-window target argmaxes.
+
+    ``draft_tokens`` are the ``k`` drafted ids; ``target_tokens`` are
+    the ``k+1`` per-window target argmaxes — ``target_tokens[i]`` is
+    the target's greedy choice at the position of ``draft_tokens[i]``
+    (conditioned on the drafted prefix before it), and
+    ``target_tokens[k]`` is the bonus position. Returns ``(accepted,
+    next_token)``: the longest agreeing prefix length, plus the token
+    to emit after it — the target's own choice at the first
+    disagreement, or the bonus token on full acceptance. The emitted
+    window ``draft_tokens[:accepted] + [next_token]`` is therefore
+    exactly what ``accepted + 1`` plain greedy target steps would
+    have produced.
+    """
+    draft = [int(t) for t in draft_tokens]
+    target = [int(t) for t in target_tokens]
+    if len(target) != len(draft) + 1:
+        raise ValueError(
+            f"need k+1 target tokens for k draft tokens, got "
+            f"{len(target)} for {len(draft)}")
+    accepted = 0
+    for d, t in zip(draft, target):
+        if d != t:
+            break
+        accepted += 1
+    return accepted, target[accepted]
+
+
+def speculative_accept(draft_tokens: Sequence[int],
+                       draft_probs: np.ndarray,
+                       target_probs: np.ndarray,
+                       rng: np.random.Generator,
+                       ) -> Tuple[int, List[int]]:
+    """The full (sampled) rejection rule over one drafted window.
+
+    ``draft_tokens``: the ``k`` ids the draft sampled;
+    ``draft_probs``: ``(k, V)`` — the draft distribution each was
+    sampled from; ``target_probs``: ``(k+1, V)`` — the target
+    distribution at each drafted position plus the bonus position.
+    Returns ``(accepted, emitted)`` where ``emitted`` is
+    ``draft_tokens[:accepted]`` plus one more token: a residual
+    resample at the first rejection, or a bonus sample from
+    ``target_probs[k]`` on full acceptance.
+
+    The classic guarantee (tests/test_speculative.py pins it with a
+    seeded chi-square): each emitted token is marginally distributed
+    exactly as sampling ``target_probs`` directly, independent of the
+    draft. With one-hot rows this reduces bit-for-bit to
+    :func:`greedy_accept`.
+    """
+    draft_probs = np.asarray(draft_probs, np.float64)
+    target_probs = np.asarray(target_probs, np.float64)
+    k = len(draft_tokens)
+    if draft_probs.shape[0] != k or target_probs.shape[0] != k + 1:
+        raise ValueError(
+            f"shape mismatch: {k} draft tokens, draft_probs "
+            f"{draft_probs.shape}, target_probs {target_probs.shape}")
+    emitted: List[int] = []
+    for i, d in enumerate(int(t) for t in draft_tokens):
+        p, q = target_probs[i, d], draft_probs[i, d]
+        # q == 0 means the draft claims it sampled a zero-probability
+        # token — treat as certain rejection rather than dividing
+        if q > 0.0 and rng.random() < min(1.0, p / q):
+            emitted.append(d)
+            continue
+        residual = np.clip(target_probs[i] - draft_probs[i], 0.0, None)
+        total = residual.sum()
+        if total <= 0.0:
+            # p <= q everywhere can only happen when p == q: any
+            # renormalization noise falls back to the target itself
+            residual, total = target_probs[i], target_probs[i].sum()
+        return i, emitted + [int(rng.choice(
+            residual.size, p=residual / total))]
+    bonus = target_probs[k]
+    return k, emitted + [int(rng.choice(
+        bonus.size, p=bonus / bonus.sum()))]

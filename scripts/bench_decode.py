@@ -37,6 +37,13 @@ In this mode the headline TTFT gate judges the WARM arm (the cold arm
 deliberately convoys ``--streams`` unique long-prompt prefills as the
 control; its cost is gated relatively via the warm/cold ratio).
 
+``--speculative`` runs a different two-arm trace instead: the same
+plans on a plain engine and on a ``--spec-k`` self-draft speculative
+engine, gating token-exactness, acceptance rate
+(``--spec-accept-gate``), tokens per target step >= ``--spec-gate`` x
+the plain arm, and zero post-warmup compiles in both arms
+(docs/SERVING.md "Speculative decoding").
+
 The TTFT phase breakdown is derived from the request trace spans
 (``obs/trace.py``): per stream, ``queue_wait`` (admission), the
 ``prefill_chunk`` steps before the one that completed the prompt, and
@@ -152,6 +159,148 @@ def _ttft_phases(spans):
     return out
 
 
+def _run_speculative(args, task, geometry, plans):
+    """The ``--speculative`` two-arm trace.
+
+    Arm A decodes the plans on a plain engine (one token per decode
+    step); arm B decodes the SAME plans with ``spec_k`` self-draft
+    speculation (the draft shares the target's weights, so greedy
+    acceptance is ~1.0 and each verify step can commit up to k+1
+    tokens). Four hard gates:
+
+    - **token-exactness** — the spec arm's emitted streams must equal
+      the plain arm's, token for token (the rejection rule's whole
+      contract: speculation changes latency, never output);
+    - **acceptance** — acceptance rate >= ``--spec-accept-gate``;
+    - **tokens/step** — the spec arm's tokens per target step must be
+      >= ``--spec-gate`` x the plain arm's (the headline win: fewer
+      sequential target dispatches for the same tokens);
+    - **zero post-warmup compiles** in BOTH arms — drafted lanes ride
+      the same stepped signature, so speculation must not widen the
+      exec-cache key set mid-traffic.
+    """
+    from dataclasses import replace
+
+    from perceiver_tpu.serving.decode import DecodeEngine
+    from perceiver_tpu.serving.speculative import SpeculativeConfig
+
+    def _arm(spec: bool):
+        g = replace(geometry, spec_k=args.spec_k) if spec else geometry
+        engine = DecodeEngine(
+            task, geometry=g, auto_step=True,
+            max_queue=args.streams + 1,
+            token_budget=args.token_budget or None,
+            speculative=SpeculativeConfig() if spec else None)
+        t0 = time.monotonic()
+        with _compile_events() as compiles:
+            handles = []
+            for prompt, max_new, _a in plans:
+                handles.append(
+                    engine.submit(prompt, max_new_tokens=max_new))
+                time.sleep(0.01)
+            results = [h.result(timeout=600.0) for h in handles]
+        wall = time.monotonic() - t0
+        steps = engine.metrics.counter(
+            "serving_decode_steps_total",
+            "decode step executions").value
+        stats = engine.speculative_stats()
+        engine.close()
+        tokens = sum(len(r.tokens) for r in results)
+        for (_p, max_new, _a), r in zip(plans, results):
+            assert r.finished == "complete", r
+            assert len(r.tokens) == max_new
+        return {
+            "results": results,
+            "tokens": tokens,
+            "steps": int(steps),
+            "tokens_per_step": tokens / max(1, steps),
+            "tokens_per_sec": round(tokens / wall, 1),
+            "wall_s": round(wall, 2),
+            "compiles": len(compiles),
+            "stats": stats,
+            "descriptor": g.descriptor,
+        }
+
+    plain = _arm(spec=False)
+    spec = _arm(spec=True)
+
+    ratio = spec["tokens_per_step"] / plain["tokens_per_step"]
+    acceptance = (spec["stats"] or {}).get("acceptance_rate", 0.0)
+    exact = all(r1.tokens == r2.tokens for r1, r2 in
+                zip(plain["results"], spec["results"]))
+    ratio_ok = ratio >= args.spec_gate
+    accept_ok = acceptance >= args.spec_accept_gate
+    compiles_ok = plain["compiles"] == 0 and spec["compiles"] == 0
+
+    import jax
+    dev = jax.devices()[0]
+
+    def _arm_detail(arm):
+        d = {k: arm[k] for k in ("tokens", "steps", "tokens_per_step",
+                                 "tokens_per_sec", "wall_s",
+                                 "compiles", "descriptor")}
+        d["tokens_per_step"] = round(d["tokens_per_step"], 4)
+        return d
+
+    result = {
+        "metric": "decode_spec_tokens_per_step_ratio",
+        "value": round(ratio, 4),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "detail": {
+            "preset": args.preset,
+            "streams": args.streams,
+            "prompt_len": args.prompt_len,
+            "max_new_range": [args.max_new_min, args.max_new_max],
+            "spec_k": args.spec_k,
+            "draft": "self",
+            "plain": _arm_detail(plain),
+            "speculative": _arm_detail(spec),
+            "acceptance_rate": round(acceptance, 4),
+            "accept_gate": args.spec_accept_gate,
+            "drafted_tokens": int(
+                (spec["stats"] or {}).get("drafted_tokens", 0)),
+            "accepted_tokens": int(
+                (spec["stats"] or {}).get("accepted_tokens", 0)),
+            "verify_steps": int(
+                (spec["stats"] or {}).get("verify_steps", 0)),
+            "fallbacks": int(
+                (spec["stats"] or {}).get("fallbacks", 0)),
+            "token_exact": exact,
+            "spec_gate": args.spec_gate,
+            "post_warmup_compiles": plain["compiles"]
+            + spec["compiles"],
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+        },
+    }
+    line = json.dumps(result)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not exact:
+        print("[bench_decode] FAIL: speculative arm diverged from the "
+              "plain arm — the rejection rule must keep greedy decode "
+              "token-exact", file=sys.stderr)
+    if not accept_ok:
+        print(f"[bench_decode] FAIL: acceptance rate {acceptance:.4f} "
+              f"< {args.spec_accept_gate} — the self-draft arm should "
+              f"accept nearly everything", file=sys.stderr)
+    if not ratio_ok:
+        print(f"[bench_decode] FAIL: tokens/step ratio {ratio:.4f} < "
+              f"{args.spec_gate}x — speculation is not compressing "
+              f"sequential target steps", file=sys.stderr)
+    if not compiles_ok:
+        print(f"[bench_decode] FAIL: post-warmup XLA compiles (plain "
+              f"{plain['compiles']}, spec {spec['compiles']}) — "
+              f"drafted lanes changed a step signature mid-traffic",
+              file=sys.stderr)
+    code = 0 if (exact and accept_ok and ratio_ok and compiles_ok) \
+        else 1
+    return code, result
+
+
 def run(argv=None):
     """The bench body: returns ``(exit_code, result_dict)`` so tests
     can drive it in-process; ``main`` wraps it for the CLI."""
@@ -195,9 +344,26 @@ def run(argv=None):
     ap.add_argument("--prefix-ttft-gate", type=float, default=0.5,
                     help="warm ttft p95 must be <= gate * cold ttft "
                          "p95")
+    ap.add_argument("--speculative", action="store_true",
+                    help="two-arm speculative trace: a plain engine "
+                         "and a spec_k self-draft engine decode the "
+                         "SAME plans; gates token-exactness, "
+                         "acceptance rate, and tokens/verify-step")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="drafted tokens per verify step (default 4)")
+    ap.add_argument("--spec-gate", type=float, default=1.5,
+                    help="speculative tokens/step must be >= gate x "
+                         "the plain arm's")
+    ap.add_argument("--spec-accept-gate", type=float, default=0.9,
+                    help="speculative acceptance rate must be >= this "
+                         "(self-draft proposes from the target's own "
+                         "weights, so ~1.0)")
     ap.add_argument("--out", default=None,
                     help="also write the result JSON to this path")
     args = ap.parse_args(argv)
+    if args.speculative and args.shared_prefix:
+        ap.error("--speculative and --shared-prefix are separate "
+                 "traces; run them as two invocations")
 
     from perceiver_tpu.obs import trace as trace_mod
     from perceiver_tpu.serving.decode import DecodeEngine, DecodeGeometry
@@ -266,6 +432,9 @@ def run(argv=None):
     else:
         plans = [(_ids(args.prompt_len), _max_new(), "solo")
                  for _ in range(args.streams)]
+
+    if args.speculative:
+        return _run_speculative(args, task, geometry, plans)
 
     prefix_cfg = None
     if args.shared_prefix:

@@ -1424,6 +1424,187 @@ def scenario_prefix_evict_under_load(tmp: str) -> dict:
             "faults_fired": {"prefix.evict_pressure": evicted}}
 
 
+def scenario_spec_reject_storm(tmp: str) -> dict:
+    """Speculative decoding under adversarial 0%-acceptance
+    (``serving.speculative``): the draft is a shrunk model with
+    randomly initialized weights (``draft_seed`` only — never trained),
+    so virtually every drafted token is rejected and every verify step
+    rolls the target KV *and* the draft KV back by the full window.
+    ``fallback_acceptance=0.0`` pins speculation ON, so the storm never
+    de-escalates into plain decode — the rollback path runs for every
+    stream on every step.
+
+    Two phases, following the race_*/prefix_evict pattern:
+
+    1. **Deterministic token-exactness.** A manually stepped
+       speculative engine driven by seeded admission schedules must
+       complete every request bit-identical to a plain (spec_k=0)
+       reference engine sharing the same target params — the rejection
+       rule's contract that speculation changes latency, never output,
+       held at its worst case. Each seed's completion log replays
+       bitwise-identically, and after every run BOTH arenas (target
+       and draft) must be fully free.
+    2. **Free-threaded liveness.** Client threads hammer an
+       auto-stepping speculative engine; zero dropped requests, every
+       completion still token-exact, and both arenas fully reclaimed
+       at drain — a rejected window must never strand a page."""
+    import threading
+    from dataclasses import replace as _dc_replace
+
+    import numpy as np
+
+    from perceiver_tpu.serving.decode import (
+        DecodeEngine,
+        DecodeGeometry,
+        DecodeResult,
+    )
+    from perceiver_tpu.serving.speculative import (
+        SpeculativeConfig,
+        shrink_task,
+    )
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    task = MaskedLanguageModelTask(
+        vocab_size=110, max_seq_len=32, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+    geometry = DecodeGeometry(max_streams=3, num_pages=17, page_size=4,
+                              max_seq_len=32, max_chunk=4, spec_k=3)
+    spec_cfg = SpeculativeConfig(draft_task=shrink_task(task),
+                                 draft_seed=1234,
+                                 fallback_acceptance=0.0)
+    engine = DecodeEngine(task, geometry=geometry, auto_step=False,
+                          max_queue=64, speculative=spec_cfg)
+    params = engine.params
+    reference = DecodeEngine(task, params=params,
+                             geometry=_dc_replace(geometry, spec_k=0),
+                             auto_step=True, max_queue=64)
+
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(3, 100, size=n).astype(np.int32)
+               for n in (5, 9, 11, 7)]
+    MAX_NEW = 6
+
+    expect = {}
+    for p in prompts:
+        r = reference.submit(p, max_new_tokens=MAX_NEW).result(120.0)
+        assert isinstance(r, DecodeResult) and r.finished == "complete"
+        expect[p.tobytes()] = list(r.tokens)
+    reference.close()
+
+    def _arenas_free(eng):
+        assert eng.pool.free_pages == geometry.allocatable_pages, (
+            f"target arena leaked: {eng.pool.free_pages} free of "
+            f"{geometry.allocatable_pages}")
+        assert (eng.draft_pool.free_pages
+                == geometry.allocatable_pages), (
+            f"draft arena leaked: {eng.draft_pool.free_pages} free of "
+            f"{geometry.allocatable_pages}")
+
+    # -- phase 1: deterministic token-exactness under total rejection --
+    seeds = [0, 11]
+    exact = 0
+
+    def run_once(seed: int):
+        nonlocal exact
+        srng = np.random.default_rng(seed)
+        handles = []
+        for i in range(10):
+            p = prompts[i % len(prompts)]
+            handles.append((p.tobytes(),
+                            engine.submit(p, max_new_tokens=MAX_NEW)))
+            for _ in range(int(srng.integers(0, 4))):
+                engine.step()
+        engine.run_until_idle()
+        log = []
+        for key, h in handles:
+            r = h.result(1.0)
+            assert isinstance(r, DecodeResult), f"dropped request: {r}"
+            assert r.finished == "complete" and len(r.tokens) == MAX_NEW
+            assert r.tokens == expect[key], (
+                f"seed {seed}: rejection rollback leaked into tokens: "
+                f"{r.tokens} != {expect[key]}")
+            exact += 1
+            log.append(tuple(r.tokens))
+        _arenas_free(engine)
+        return log
+
+    for seed in seeds:
+        first = run_once(seed)
+        assert run_once(seed) == first, f"seed {seed} not deterministic"
+    det_stats = engine.speculative_stats()
+    assert det_stats["drafted_tokens"] > 0, "draft never proposed"
+    assert det_stats["acceptance_rate"] <= 0.2, (
+        f"storm not adversarial: acceptance "
+        f"{det_stats['acceptance_rate']}")
+    assert det_stats["fallbacks"] == 0, \
+        "fallback fired despite fallback_acceptance=0.0"
+    engine.close()
+    rejected = int(det_stats["drafted_tokens"]
+                   - det_stats["accepted_tokens"])
+    assert rejected >= 1, "no rejection ever rolled back a window"
+
+    # -- phase 2: free-threaded liveness under the same storm --
+    engine = DecodeEngine(task, params=params, geometry=geometry,
+                          auto_step=True, max_queue=64,
+                          speculative=spec_cfg)
+    results, errors = [], []
+    res_lock = threading.Lock()
+
+    def client(worker: int):
+        def run():
+            try:
+                for i in range(5):
+                    p = prompts[(worker + i) % len(prompts)]
+                    r = engine.submit(
+                        p, max_new_tokens=MAX_NEW).result(120.0)
+                    with res_lock:
+                        results.append((p.tobytes(), r))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                with res_lock:
+                    errors.append(e)
+        return run
+
+    threads = [threading.Thread(target=client(w), name=f"client-{w}")
+               for w in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300.0)
+        assert not t.is_alive(), f"{t.name} hung"
+    assert not errors, f"client errors: {errors!r}"
+
+    assert engine.drain(60.0), "engine failed to drain"
+    dropped = sum(1 for _, r in results
+                  if not isinstance(r, DecodeResult))
+    for key, r in results:
+        assert isinstance(r, DecodeResult), f"dropped request: {r}"
+        assert r.finished == "complete" and len(r.tokens) == MAX_NEW
+        # greedy decode is schedule-independent, so exactness holds
+        # under free threading too (no cache state to interleave)
+        assert r.tokens == expect[key], (
+            f"threaded storm leaked into tokens: {r.tokens} != "
+            f"{expect[key]}")
+    assert len(results) == 15, f"expected 15 completions: {len(results)}"
+    _arenas_free(engine)
+    live_stats = engine.speculative_stats()
+    engine.close()
+    rejected += int(live_stats["drafted_tokens"]
+                    - live_stats["accepted_tokens"])
+    return {"clients": 3, "requests": exact + len(results),
+            "dropped": dropped,
+            "seeds": seeds, "deterministic_replays": len(seeds),
+            "drafted_tokens": int(det_stats["drafted_tokens"]
+                                  + live_stats["drafted_tokens"]),
+            "rejected_tokens": rejected,
+            "acceptance_rate": round(live_stats["acceptance_rate"], 4),
+            "leak_free": True, "token_exact": True,
+            "faults_fired": {"spec.reject_storm": rejected}}
+
+
 # scenario name -> (fault plan armed via PERCEIVER_FAULTS, fn)
 _SCENARIOS = {
     "loader_crash": ("loader.exception@at=1,count=2",
@@ -1443,6 +1624,9 @@ _SCENARIOS = {
     # the "fault" is page pressure: a unique-prefix flood that can
     # only admit by evicting the prefix index's LRU chains
     "prefix_evict_under_load": (None, scenario_prefix_evict_under_load),
+    # the "fault" is a never-trained draft: ~0% acceptance forces the
+    # speculative rollback path on every verify step
+    "spec_reject_storm": (None, scenario_spec_reject_storm),
     # fleet scenarios arm faults per-REPLICA (supervisor env overrides)
     # rather than in the scenario child, so the plan column stays None
     "fleet_kill_replica": (None, scenario_fleet_kill_replica),
@@ -1458,9 +1642,11 @@ _SCENARIOS = {
 }
 _MATRIX = ["loader_crash", "nan_skip", "nan_rewind", "truncated_ckpt",
            "kill_save", "preempt", "serve_dispatch", "race_admission",
-           "race_mixed_prefill", "prefix_evict_under_load"]
+           "race_mixed_prefill", "prefix_evict_under_load",
+           "spec_reject_storm"]
 _FAST = ["nan_skip", "serve_dispatch", "race_admission",
-         "race_mixed_prefill", "prefix_evict_under_load"]
+         "race_mixed_prefill", "prefix_evict_under_load",
+         "spec_reject_storm"]
 _FLEET_MATRIX = ["fleet_kill_replica", "fleet_stall",
                  "fleet_rollout_corrupt", "fleet_rollout"]
 _FLEET_FAST = ["fleet_kill_replica"]

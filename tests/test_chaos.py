@@ -51,6 +51,19 @@ def test_chaos_fast_matrix_survives():
     assert evict["deterministic_replays"] == len(evict["seeds"])
     assert evict["client_requests"] > 0
     assert evict["faults_fired"].get("prefix.evict_pressure", 0) >= 1
+    # speculative rejection storm (ISSUE 19): a never-trained draft
+    # drives ~0% acceptance, so every verify step exercises the KV
+    # rollback path — token-exact vs a plain-decode reference, zero
+    # drops under free threads, both arenas (target + draft) fully
+    # reclaimed, and seeded schedules replay bitwise
+    storm = by_metric["chaos_spec_reject_storm"]["detail"]
+    assert storm["token_exact"] is True
+    assert storm["dropped"] == 0
+    assert storm["leak_free"] is True
+    assert storm["acceptance_rate"] <= 0.2
+    assert storm["rejected_tokens"] >= 1
+    assert storm["deterministic_replays"] == len(storm["seeds"])
+    assert storm["faults_fired"].get("spec.reject_storm", 0) >= 1
 
 
 def test_chaos_fleet_fast_survives():

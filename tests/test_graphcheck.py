@@ -572,27 +572,32 @@ def _tiny_decode_target(name="tiny_decode"):
 
 
 def test_decode_targets_registered_and_budgeted():
-    """Both decode targets ride CANONICAL_TARGETS (check.py --all) and
-    carry pinned hbm budgets; the sharded variant is additionally
-    pinned in shard_budgets.json. An unbudgeted decode step would
-    silently opt the O(1)-memory claim out of the merge gate."""
+    """All decode targets — mixed-phase and the speculative k=4 verify
+    step — ride CANONICAL_TARGETS (check.py --all) and carry pinned hbm
+    budgets; the sharded variants are additionally pinned in
+    shard_budgets.json. An unbudgeted decode step would silently opt
+    the O(1)-memory claim out of the merge gate."""
     from perceiver_tpu.analysis import DECODE_TARGETS, FAST_TARGETS
     from perceiver_tpu.analysis.shardcheck import load_shard_budgets
 
     names = {t.name for t in DECODE_TARGETS}
-    assert names == {"decode_mixed_mlm_r8_p64x16_q8"}
+    assert names == {"decode_mixed_mlm_r8_p64x16_q8",
+                     "decode_spec_mlm_r8_p64x16_q8_k4"}
     assert all(t.kind == "decode" for t in DECODE_TARGETS)
     canonical = {t.name for t in CANONICAL_TARGETS}
     assert names <= canonical
-    spmd = "decode_mixed_mlm_spmd_r8_p48x16_q8_dp2_tp2"
-    assert spmd in canonical
-    assert names | {spmd} <= set(load_hbm_budgets())
+    spmd_names = {"decode_mixed_mlm_spmd_r8_p48x16_q8_dp2_tp2",
+                  "decode_spec_mlm_spmd_r8_p48x16_q8_k4_dp2_tp2"}
+    assert spmd_names <= canonical
+    assert names | spmd_names <= set(load_hbm_budgets())
     shard = load_shard_budgets()
-    assert spmd in shard and shard[spmd]["collectives"]
-    # the unsharded step is forward-only and compile-cheap: fast tier;
-    # the mesh variant pays an XLA compile, so --all/--graph only
+    for spmd in spmd_names:
+        assert spmd in shard and shard[spmd]["collectives"]
+    # the unsharded steps are forward-only and compile-cheap: fast
+    # tier; the mesh variants pay an XLA compile, so --all/--graph only
     fast = {t.name for t in FAST_TARGETS}
-    assert names <= fast and spmd not in fast
+    assert names <= fast
+    assert not (spmd_names & fast)
 
 
 def test_decode_step_donation_contract_lowered():
@@ -629,6 +634,95 @@ def test_decode_hbm_budget_seeded_violation_through_runner(
     from perceiver_tpu.analysis import DECODE_TARGETS
 
     target = DECODE_TARGETS[0]
+    with open(passes_mod._HBM_MANIFEST) as f:
+        manifest = _json.load(f)
+    manifest["targets"][target.name]["budget_bytes"] = 1
+    path = str(tmp_path / "budgets.json")
+    with open(path, "w") as f:
+        _json.dump(manifest, f)
+    monkeypatch.setattr(passes_mod, "_HBM_MANIFEST", path)
+    monkeypatch.setattr(passes_mod, "lower_target", lowered_target_cache)
+    report = run_graph_checks([target], recompile=False)
+    assert not report.ok
+    assert any(v.check == "hbm_budget" and v.where == target.name
+               for v in report.violations)
+
+
+# --- speculative decode targets (ISSUE 19) ----------------------------------
+
+
+def _tiny_spec_decode_target(name="tiny_spec_decode", spec_k=2):
+    def build():
+        from perceiver_tpu.serving.decode import DecodeGeometry
+
+        task = _tiny_mlm()
+        # mixed phase: row 0 prefills a full chunk, row 1 verifies a
+        # k+1-lane speculative window (feedback + 2 drafted tokens)
+        return task, {
+            "geometry": DecodeGeometry(max_streams=2, num_pages=5,
+                                       page_size=4, max_seq_len=16,
+                                       max_chunk=4, spec_k=spec_k),
+            "tokens": jnp.asarray([[7, 9, 11, 13], [9, 5, 3, 0]],
+                                  jnp.int32),
+            "qlens": jnp.asarray([4, 3], jnp.int32),
+        }
+
+    return StepTarget(name=name, build=build, kind="decode")
+
+
+def test_spec_decode_step_donation_contract_lowered():
+    """The speculative verify step keeps the EXACT donation contract of
+    the plain decode step: window tiling widens latents/logits (pure
+    activations) but the carry is still one paged cache — k1, v1,
+    lengths, page_tables all alias in place. A second cache copy here
+    would double decode HBM for every speculative stream."""
+    lowered = lower_target(_tiny_spec_decode_target())
+    assert lowered.expected_donated == 4  # k1, v1, lengths, page_tables
+    assert not donation_check(lowered.text, where="tiny_spec_decode",
+                              expected_donated=lowered.expected_donated)
+    assert not transfer_guard(lowered.text, where="tiny_spec_decode")
+
+
+def test_spec_decode_target_recompile_closure():
+    """Independent rebuilds of the speculative step lower
+    byte-identically — the engine compiles ONE verify executable per
+    (geometry, spec_k) descriptor at admission time, and any signature
+    drift would be a mid-traffic recompile (the zero-compile bench
+    gate's failure mode)."""
+    violations, fp = recompile_budget(_tiny_spec_decode_target())
+    assert not violations
+    assert fp
+
+
+def test_spec_decode_descriptor_distinct_from_plain():
+    """spec_k widens the exec-cache key: the k>0 descriptor must never
+    collide with the plain decode entry (a collision would serve the
+    1-lane executable to verify rows), and k=0 must keep the exact
+    legacy descriptor so existing pins/caches stay valid."""
+    from perceiver_tpu.serving.decode import DecodeGeometry
+
+    plain = DecodeGeometry(max_streams=2, num_pages=5, page_size=4,
+                           max_seq_len=16, max_chunk=4)
+    spec = DecodeGeometry(max_streams=2, num_pages=5, page_size=4,
+                          max_seq_len=16, max_chunk=4, spec_k=2)
+    assert spec.descriptor != plain.descriptor
+    assert spec.descriptor.endswith("_k2")
+    assert "_k" not in plain.descriptor
+
+
+def test_spec_decode_hbm_budget_seeded_violation_through_runner(
+        tmp_path, monkeypatch, lowered_target_cache):
+    """Shrink the checked-in budget for the REGISTERED speculative
+    target and the full runner must trip hbm_budget — the k=4 verify
+    step's memory pin is an enforced merge gate, not a one-time
+    measurement."""
+    import json as _json
+
+    import perceiver_tpu.analysis.passes as passes_mod
+    from perceiver_tpu.analysis import DECODE_TARGETS
+
+    target = next(t for t in DECODE_TARGETS
+                  if t.name == "decode_spec_mlm_r8_p64x16_q8_k4")
     with open(passes_mod._HBM_MANIFEST) as f:
         manifest = _json.load(f)
     manifest["targets"][target.name]["budget_bytes"] = 1

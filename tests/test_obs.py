@@ -320,6 +320,60 @@ def test_serving_batcher_registry_conforms():
     assert promparse.check_exposition(registry.render()) == []
 
 
+def test_decode_page_pool_gauges_conform_and_aggregate():
+    """ISSUE 19 satellite: the decode arenas export occupancy gauges
+    (``serving_page_pool_used_pages`` / ``_free_pages``, one sample per
+    arena — ``target`` always, ``draft`` when speculation is on) that
+    render a clean exposition and survive fleet aggregation with the
+    replica label injected."""
+    import numpy as np
+
+    from perceiver_tpu.serving.decode import DecodeEngine, DecodeGeometry
+    from perceiver_tpu.serving.speculative import SpeculativeConfig
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+
+    task = MaskedLanguageModelTask(
+        vocab_size=110, max_seq_len=16, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+    geometry = DecodeGeometry(max_streams=2, num_pages=9, page_size=4,
+                              max_seq_len=16, max_chunk=4, spec_k=1)
+    engine = DecodeEngine(task, geometry=geometry, auto_step=True,
+                          speculative=SpeculativeConfig())
+    try:
+        h = engine.submit(np.array([5, 7, 9], np.int32),
+                          max_new_tokens=3)
+        assert h.result(120.0).finished == "complete"
+        text = engine.metrics.render()
+    finally:
+        engine.close()
+    assert promparse.check_exposition(text) == []
+    families = promparse.parse(text)
+    for name in ("serving_page_pool_used_pages",
+                 "serving_page_pool_free_pages"):
+        arenas = {s.labels["arena"] for s in families[name].samples}
+        assert arenas == {"target", "draft"}, (name, arenas)
+    # the stream drained, so both arenas read fully free
+    used = {s.labels["arena"]: s.value
+            for s in families["serving_page_pool_used_pages"].samples}
+    assert used == {"target": 0.0, "draft": 0.0}
+    free = {s.labels["arena"]: s.value
+            for s in families["serving_page_pool_free_pages"].samples}
+    assert free["target"] == float(geometry.allocatable_pages)
+    assert free["draft"] == float(geometry.allocatable_pages)
+    # and the per-replica exposition merges through the fleet
+    # aggregator with the replica label injected on every arena sample
+    merged = merge_expositions({"r0": text, "r1": text})
+    assert promparse.check_exposition(merged) == []
+    pool = promparse.parse(merged)["serving_page_pool_used_pages"]
+    assert {s.labels["replica"] for s in pool.samples} == {"r0", "r1"}
+    assert {s.labels["arena"] for s in pool.samples} == {"target",
+                                                         "draft"}
+
+
 def test_fleet_router_registry_conforms():
     from perceiver_tpu.fleet.router import Router
 

@@ -472,7 +472,7 @@ def test_tiny_sharded_target_end_to_end(monkeypatch, tmp_path):
 # --- end-to-end: a tiny sharded DECODE step (ISSUE 14) ----------------------
 
 
-def _tiny_decode_spmd_target():
+def _tiny_decode_spmd_target(spec_k=0):
     import jax.numpy as jnp
     import numpy as np
 
@@ -484,6 +484,7 @@ def _tiny_decode_spmd_target():
         # divide over data (dp2) — same divisibility rules as the
         # canonical decode_mixed_mlm_spmd target, at compile-cheap
         # shapes; mixed qlens exercise the unified prefill+decode step
+        # (with spec_k, row 1 carries a k+1-lane verify window)
         task = MaskedLanguageModelTask(
             vocab_size=128, max_seq_len=32, num_latents=4,
             num_latent_channels=16, num_encoder_layers=2,
@@ -492,14 +493,17 @@ def _tiny_decode_spmd_target():
         return task, {
             "geometry": DecodeGeometry(max_streams=4, num_pages=9,
                                        page_size=4, max_seq_len=32,
-                                       max_chunk=4),
+                                       max_chunk=4, spec_k=spec_k),
             "tokens": jnp.asarray(rng.integers(3, 128, (4, 4)),
                                   jnp.int32),
-            "qlens": jnp.asarray([4, 1, 2, 1], jnp.int32),
+            "qlens": jnp.asarray(
+                [4, 1 + spec_k, 2, 1], jnp.int32),
             "attn_impl": "reference",
         }
 
-    return StepTarget(name="tiny_decode_spmd_dp2_tp2", build=build,
+    name = ("tiny_decode_spmd_dp2_tp2" if not spec_k
+            else f"tiny_spec_decode_spmd_k{spec_k}_dp2_tp2")
+    return StepTarget(name=name, build=build,
                       kind="decode", mesh=DP2_TP2)
 
 
@@ -538,6 +542,55 @@ def test_tiny_sharded_decode_target_end_to_end(tmp_path):
     vs, _ = run_shard_passes(lowered, budgets=budgets)
     assert not vs, vs
     # seeded failures: missing pin and zeroed budgets both trip
+    vs, _ = run_shard_passes(lowered, budgets={})
+    assert {v.check for v in vs} == {"collective_budget",
+                                    "per_shard_hbm_budget"}
+    zeroed = json.loads(json.dumps(budgets))
+    for axis in zeroed[target.name]["collectives"].values():
+        axis["budget_bytes"] = 0
+    zeroed[target.name]["per_shard"]["budget_bytes"] = 0
+    vs, _ = run_shard_passes(lowered, budgets=zeroed)
+    assert any(v.check == "collective_budget" and "exceeds"
+               in v.message for v in vs)
+    assert any(v.check == "per_shard_hbm_budget" for v in vs)
+
+
+# --- end-to-end: a tiny sharded SPECULATIVE decode step (ISSUE 19) ----------
+
+
+def test_tiny_sharded_spec_decode_target_end_to_end(tmp_path):
+    """The speculative verify step under dp2×tp2: window tiling folds
+    the k+1 lanes into the kernel row axis, so GSPMD partitions the
+    SAME program shape as plain decode — the carry stays fully donated
+    (one paged cache per shard), collectives still appear, and its pin
+    round-trips through shard_budgets with seeded violations tripping
+    on an emptied or zeroed manifest."""
+    from perceiver_tpu.analysis import donation_check
+
+    target = _tiny_decode_spmd_target(spec_k=2)
+    lowered = lower_target(target)
+    assert lowered.compiled_text, "mesh target must carry compiled HLO"
+    assert lowered.expected_donated == 6  # k1 v1 kn vn lengths tables
+    assert not donation_check(lowered.text, where=target.name,
+                              expected_donated=lowered.expected_donated)
+    assert not replication_check(lowered.text, where=target.name,
+                                 floor_bytes=DEFAULT_FLOOR_BYTES)
+
+    inv = collective_inventory(lowered.compiled_text, target.mesh)
+    assert inv["collectives"], \
+        "GSPMD inserted no collectives — the step stopped being SPMD"
+
+    path = str(tmp_path / "shard_budgets.json")
+    write_shard_budgets({target.name: {
+        "mesh": target.mesh.descriptor,
+        "collectives": inv["collectives"],
+        "ops": inv["ops"],
+        "per_shard": lowered.bytes_accessed / target.mesh.n_devices,
+    }}, path=path, note="test")
+    budgets = load_shard_budgets(path)
+
+    vs, _ = run_shard_passes(lowered, budgets=budgets)
+    assert not vs, vs
     vs, _ = run_shard_passes(lowered, budgets={})
     assert {v.check for v in vs} == {"collective_budget",
                                     "per_shard_hbm_budget"}

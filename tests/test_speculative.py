@@ -1,0 +1,432 @@
+"""Speculative decoding (ISSUE 19): the rejection rule, the draft
+policy, and the engine's verify-in-one-step path.
+
+The load-bearing properties:
+
+- **rejection rule** — :func:`speculative_accept` emits tokens
+  distributed EXACTLY as sampling the target alone (seeded chi-square
+  over a tiny vocab), and :func:`greedy_accept` is its one-hot
+  degeneration: greedy speculative decode is token-exact against
+  non-speculative decode by construction, including mid-window
+  rejection and full-window acceptance edges;
+- **engine parity** — a self-draft speculative engine generates
+  bit-identical streams to a plain engine under fp32 AND bf16,
+  including streams admitted through a warm prefix-cache hit, with
+  zero post-warmup compiles;
+- **fallback** — when acceptance collapses (a never-trained draft),
+  the stream flips to plain decode, frees its draft pages, and stays
+  token-exact;
+- **scheduler** — drafted tokens cost real step budget
+  (``plan_speculative``), degrading FIFO toward plain decode before
+  starving prefill;
+- **facades** — the r18 ``AdmissionQueue`` / ``TokenBudgetBatcher``
+  names still construct and behave, but warn ``DeprecationWarning``.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from perceiver_tpu.ops.policy import Policy
+from perceiver_tpu.serving.batcher import (
+    AdmissionQueue,
+    ContinuousBatchScheduler,
+    TokenBudgetBatcher,
+)
+from perceiver_tpu.serving.decode import (
+    DecodeEngine,
+    DecodeGeometry,
+    DecodeResult,
+)
+from perceiver_tpu.serving.prefix_cache import PrefixCacheConfig
+from perceiver_tpu.serving.speculative import (
+    SpeculativeConfig,
+    greedy_accept,
+    shrink_task,
+    speculative_accept,
+)
+from perceiver_tpu.tasks.mlm import MaskedLanguageModelTask
+
+VOCAB = 110
+
+
+def tiny_task():
+    return MaskedLanguageModelTask(
+        vocab_size=VOCAB, max_seq_len=48, num_latents=4,
+        num_latent_channels=8, num_encoder_layers=1,
+        num_encoder_self_attention_layers_per_block=1,
+        num_encoder_cross_attention_heads=1,
+        num_encoder_self_attention_heads=1,
+        num_decoder_cross_attention_heads=1, loss_impl="dense")
+
+
+def tiny_geometry(**kw):
+    base = dict(max_streams=3, num_pages=33, page_size=4,
+                max_seq_len=48, max_chunk=4)
+    base.update(kw)
+    return DecodeGeometry(**base)
+
+
+# --- greedy_accept edges -----------------------------------------------------
+
+
+def test_greedy_accept_full_window():
+    # every drafted token matches → all accepted + the bonus token
+    assert greedy_accept([3, 5, 7], [3, 5, 7, 9]) == (3, 9)
+
+
+def test_greedy_accept_mid_window_rejection():
+    # target disagrees at position 1 → keep [3], emit the target's own
+    # choice at the disagreement, drop the rest of the window
+    assert greedy_accept([3, 5, 7], [3, 6, 7, 9]) == (1, 6)
+
+
+def test_greedy_accept_first_token_rejection():
+    assert greedy_accept([3, 5], [4, 5, 9]) == (0, 4)
+
+
+def test_greedy_accept_empty_window_is_plain_decode():
+    # k=0 degenerates to one plain greedy step
+    assert greedy_accept([], [8]) == (0, 8)
+
+
+def test_greedy_accept_requires_k_plus_one_targets():
+    with pytest.raises(ValueError, match="k\\+1 target"):
+        greedy_accept([3, 5], [3, 5])
+
+
+# --- speculative_accept: the distribution-match property ---------------------
+
+
+def test_speculative_accept_matches_target_distribution():
+    """The classic guarantee, pinned with a seeded chi-square: the
+    first emitted token of each window is marginally distributed
+    exactly as sampling the target distribution directly, no matter
+    how bad the draft is."""
+    rng = np.random.default_rng(19)
+    v = 4
+    # deliberately mismatched draft: it loves token 0, target doesn't
+    q = np.array([[0.7, 0.1, 0.1, 0.1]])
+    p_rows = np.array([[0.1, 0.4, 0.3, 0.2],
+                       [0.25, 0.25, 0.25, 0.25]])
+    n = 20_000
+    counts = np.zeros(v)
+    for _ in range(n):
+        d = int(rng.choice(v, p=q[0]))
+        _, emitted = speculative_accept([d], q, p_rows, rng)
+        counts[emitted[0]] += 1
+    expected = n * p_rows[0]
+    chi2 = float(((counts - expected) ** 2 / expected).sum())
+    # df = 3; chi2 > 16.27 has p < 0.001 — seeded, so deterministic
+    assert chi2 < 16.27, (chi2, counts / n, p_rows[0])
+
+
+def test_speculative_accept_bonus_distribution_on_sure_accept():
+    """When draft == target the rule always accepts, and the bonus
+    token must follow the target's k+1-th row exactly."""
+    rng = np.random.default_rng(7)
+    q = np.array([[0.5, 0.5, 0.0, 0.0]])
+    p_rows = np.array([[0.5, 0.5, 0.0, 0.0],
+                       [0.05, 0.15, 0.35, 0.45]])
+    n = 20_000
+    counts = np.zeros(4)
+    for _ in range(n):
+        d = int(rng.choice(4, p=q[0]))
+        accepted, emitted = speculative_accept([d], q, p_rows, rng)
+        assert accepted == 1 and emitted[0] == d
+        counts[emitted[1]] += 1
+    nonzero = p_rows[1] > 0
+    expected = n * p_rows[1][nonzero]
+    chi2 = float(
+        ((counts[nonzero] - expected) ** 2 / expected).sum())
+    assert counts[~nonzero].sum() == 0
+    assert chi2 < 16.27, (chi2, counts / n)
+
+
+def test_speculative_accept_one_hot_reduces_to_greedy():
+    """With one-hot rows the sampled rule is bit-for-bit the greedy
+    rule — the bridge that lets the greedy engine claim the theorem's
+    token-exactness guarantee."""
+    rng = np.random.default_rng(3)
+    v = 6
+
+    def one_hot(ids):
+        rows = np.zeros((len(ids), v))
+        rows[np.arange(len(ids)), ids] = 1.0
+        return rows
+
+    cases = [
+        ([2, 4], [2, 4, 1]),   # full acceptance → bonus
+        ([2, 4], [2, 5, 1]),   # mid-window rejection
+        ([2], [3, 1]),         # immediate rejection
+    ]
+    for draft, target in cases:
+        g_acc, g_next = greedy_accept(draft, target)
+        s_acc, emitted = speculative_accept(
+            draft, one_hot(draft), one_hot(target), rng)
+        assert (s_acc, emitted) == (g_acc, draft[:g_acc] + [g_next])
+
+
+def test_speculative_accept_shape_mismatch_raises():
+    rng = np.random.default_rng(0)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        speculative_accept([1], np.ones((2, 4)) / 4,
+                           np.ones((2, 4)) / 4, rng)
+
+
+# --- draft policy ------------------------------------------------------------
+
+
+def test_shrink_task_keeps_vocab_and_shrinks_latents():
+    task = MaskedLanguageModelTask(
+        vocab_size=VOCAB, max_seq_len=48, num_latents=8,
+        num_latent_channels=32, num_encoder_layers=2,
+        num_encoder_self_attention_layers_per_block=2)
+    draft = shrink_task(task)
+    assert draft.vocab_size == task.vocab_size
+    assert draft.max_seq_len == task.max_seq_len
+    assert draft.num_latent_channels == task.num_latent_channels
+    assert draft.num_latents == 2  # quartered
+    assert draft.num_encoder_layers == 1
+    assert draft.num_encoder_self_attention_layers_per_block == 1
+    # the min-1 floor and explicit overrides
+    assert shrink_task(task, num_latents=5).num_latents == 5
+    tiny = MaskedLanguageModelTask(vocab_size=VOCAB, max_seq_len=48,
+                                   num_latents=2)
+    assert shrink_task(tiny).num_latents == 1
+
+
+def test_speculative_config_validation():
+    with pytest.raises(ValueError, match="fallback_acceptance"):
+        SpeculativeConfig(fallback_acceptance=1.5)
+    with pytest.raises(ValueError, match="ema_alpha"):
+        SpeculativeConfig(ema_alpha=0.0)
+
+
+def test_geometry_spec_k_validation_and_descriptor():
+    with pytest.raises(ValueError, match="spec_k"):
+        tiny_geometry(spec_k=-1)
+    with pytest.raises(ValueError, match="chunk lanes"):
+        tiny_geometry(spec_k=4, max_chunk=4)  # needs k+1 = 5 lanes
+    plain = tiny_geometry()
+    spec = tiny_geometry(spec_k=3)
+    assert "_k" not in plain.descriptor  # legacy keys unchanged
+    assert spec.descriptor == plain.descriptor + "_k3"
+
+
+def test_engine_requires_spec_k_and_config_together():
+    with pytest.raises(ValueError):
+        DecodeEngine(tiny_task(), geometry=tiny_geometry(spec_k=2),
+                     auto_step=False, exec_cache=False)
+    with pytest.raises(ValueError):
+        DecodeEngine(tiny_task(), geometry=tiny_geometry(),
+                     auto_step=False, exec_cache=False,
+                     speculative=SpeculativeConfig())
+
+
+# --- engine parity: speculative vs plain, fp32 + bf16 ------------------------
+
+
+@pytest.mark.parametrize("policy_name", ["fp32", "bf16"])
+def test_self_draft_speculative_token_exact(policy_name):
+    """The merge gate: a self-draft speculative engine (acceptance
+    ~1.0 — every window fully accepted) and a never-trained-draft
+    engine (acceptance ~0.0 — every window rejected and rolled back)
+    BOTH generate bit-identical streams to a plain engine, under fp32
+    and bf16, across mixed prompt lengths. Params are
+    seed-deterministic across engines, so plain-engine output is the
+    oracle."""
+    policy = getattr(Policy, policy_name)()
+    task = tiny_task()
+    rng = np.random.default_rng(19)
+    prompts = [rng.integers(3, VOCAB, size=n).astype(np.int32)
+               for n in (5, 1, 9)]
+    MAX_NEW = 8
+
+    def run_engine(spec_cfg, spec_k):
+        eng = DecodeEngine(task, geometry=tiny_geometry(spec_k=spec_k),
+                           policy=policy, auto_step=False,
+                           exec_cache=False, speculative=spec_cfg)
+        try:
+            handles = [eng.submit(p, max_new_tokens=MAX_NEW)
+                       for p in prompts]
+            eng.run_until_idle()
+            out = []
+            for h in handles:
+                r = h.result(1.0)
+                assert isinstance(r, DecodeResult)
+                assert r.finished == "complete"
+                out.append(r.tokens)
+            assert eng.pool.free_pages == \
+                eng.geometry.allocatable_pages
+            if eng.draft_pool is not None:
+                assert eng.draft_pool.free_pages == \
+                    eng.geometry.allocatable_pages
+            stats = eng.speculative_stats()
+            return out, stats
+        finally:
+            eng.close(timeout=2.0)
+
+    plain, _ = run_engine(None, 0)
+    accepted, stats = run_engine(SpeculativeConfig(), 3)
+    assert accepted == plain, (
+        f"{policy_name}: self-draft speculative diverged")
+    assert stats["acceptance_rate"] == 1.0
+    assert stats["drafted_tokens"] > 0
+    rejected, rstats = run_engine(
+        SpeculativeConfig(draft_task=shrink_task(task), draft_seed=99,
+                          fallback_acceptance=0.0), 3)
+    assert rejected == plain, (
+        f"{policy_name}: rejection rollback leaked into tokens")
+    assert rstats["acceptance_rate"] < 0.5
+
+
+@pytest.mark.parametrize("policy_name", ["fp32", "bf16"])
+def test_speculative_warm_prefix_hit_token_exact(policy_name):
+    """The acceptance criterion's hardest path: a stream admitted
+    through a WARM prefix-cache hit (shared CoW pages for the cached
+    span) on a speculative engine must still be token-exact vs a
+    plain caching-disabled engine — drafted positions always land
+    past the prompt in refcount-1 private pages, so verify rollback
+    must never touch the shared chain. Zero compiles after warmup."""
+    from tests.test_decode import compile_events
+
+    policy = getattr(Policy, policy_name)()
+    task = tiny_task()
+    rng = np.random.default_rng(18)
+    seed_prompt = rng.integers(3, VOCAB, size=17).astype(np.int32)
+    warm_prompt = np.concatenate(
+        [seed_prompt[:16], rng.integers(3, VOCAB, size=4)]
+    ).astype(np.int32)
+    MAX_NEW = 8
+
+    spec_eng = DecodeEngine(
+        task, geometry=tiny_geometry(spec_k=3), policy=policy,
+        auto_step=False, exec_cache=False,
+        speculative=SpeculativeConfig(),
+        prefix_cache=PrefixCacheConfig())
+    cold_eng = DecodeEngine(task, geometry=tiny_geometry(),
+                            policy=policy, auto_step=False,
+                            exec_cache=False)
+    try:
+        h = spec_eng.submit(seed_prompt, max_new_tokens=2)
+        spec_eng.run_until_idle()
+        assert h.result(1.0).cached_tokens == 0  # publisher ran cold
+
+        hw = spec_eng.submit(warm_prompt, max_new_tokens=MAX_NEW)
+        with compile_events() as events:
+            spec_eng.run_until_idle()
+        assert events == [], f"speculative warm hit recompiled: {events}"
+        warm = hw.result(1.0)
+        assert isinstance(warm, DecodeResult)
+        assert warm.cached_tokens == 16, warm.cached_tokens
+
+        hc = cold_eng.submit(warm_prompt, max_new_tokens=MAX_NEW)
+        cold_eng.run_until_idle()
+        cold = hc.result(1.0)
+        assert warm.tokens == cold.tokens, (
+            f"{policy_name}: warm speculative stream diverged: "
+            f"{warm.tokens} vs {cold.tokens}")
+        stats = spec_eng.speculative_stats()
+        assert stats["acceptance_rate"] == 1.0  # self-draft
+        assert stats["drafted_tokens"] > 0
+    finally:
+        spec_eng.close(timeout=2.0)
+        cold_eng.close(timeout=2.0)
+
+
+def test_acceptance_collapse_falls_back_and_frees_draft_pages():
+    """A never-trained draft with the default fallback threshold: the
+    acceptance EMA collapses, the stream permanently flips to plain
+    decode (``spec_fallback``), its draft pages free mid-flight, and
+    the output is still token-exact."""
+    task = tiny_task()
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(3, VOCAB, size=6).astype(np.int32)
+
+    plain_eng = DecodeEngine(task, geometry=tiny_geometry(),
+                             auto_step=False, exec_cache=False)
+    spec_eng = DecodeEngine(
+        task, geometry=tiny_geometry(spec_k=3), auto_step=False,
+        exec_cache=False,
+        speculative=SpeculativeConfig(draft_task=shrink_task(task),
+                                      draft_seed=99))
+    try:
+        hp = plain_eng.submit(prompt, max_new_tokens=12)
+        plain_eng.run_until_idle()
+        hs = spec_eng.submit(prompt, max_new_tokens=12)
+        spec_eng.run_until_idle()
+        assert hs.result(1.0).tokens == hp.result(1.0).tokens
+        stats = spec_eng.speculative_stats()
+        assert stats["fallbacks"] >= 1
+        assert stats["acceptance_rate"] < 1.0
+        # fallback freed the stream's draft pages mid-flight
+        assert spec_eng.draft_pool.free_pages == \
+            spec_eng.geometry.allocatable_pages
+    finally:
+        plain_eng.close(timeout=2.0)
+        spec_eng.close(timeout=2.0)
+
+
+# --- scheduler: drafted tokens cost budget -----------------------------------
+
+
+def test_plan_speculative_grants_fifo_from_leftover_budget():
+    s = ContinuousBatchScheduler(token_budget=8, max_chunk=4)
+    # 3 decode rows pre-spend 3; spec extras get the next 5 FIFO
+    grants, chunks = s.plan_speculative(3, (3, 3, 3), ())
+    assert grants == [3, 2, 0]
+    assert chunks == []
+    # prefill still gets the head-row >= 1 guarantee after spec spend
+    grants, chunks = s.plan_speculative(3, (3, 3), (4,))
+    assert grants == [3, 2]
+    assert chunks == [1]
+    # no budget → engine-default sizing grants everything
+    s = ContinuousBatchScheduler(max_chunk=4)
+    grants, chunks = s.plan_speculative(2, (3, 1), (4, 2))
+    assert grants == [3, 1]
+    assert chunks == [4, 2]
+
+
+def test_plan_chunks_is_the_no_spec_special_case():
+    s = ContinuousBatchScheduler(token_budget=6, max_chunk=4)
+    assert s.plan_chunks(2, (4, 4)) == \
+        s.plan_speculative(2, (), (4, 4))[1]
+
+
+# --- deprecated facades (satellite: one queue, one batcher) ------------------
+
+
+def test_admission_queue_warns_but_behaves():
+    with pytest.warns(DeprecationWarning, match="AdmissionQueue"):
+        q = AdmissionQueue(max_depth=4)
+    assert isinstance(q, ContinuousBatchScheduler)
+    q.offer("a", cost=2)
+    q.offer("b", cost=2)
+    assert q.depth == 2
+    admitted, shed = q.take(budget=8, slots=2)
+    assert admitted == ["a", "b"] and shed == []
+    assert q.depth == 0
+
+
+def test_token_budget_batcher_warns_but_behaves():
+    with pytest.warns(DeprecationWarning, match="TokenBudgetBatcher"):
+        b = TokenBudgetBatcher(
+            lambda batch: [{"ok": True} for _ in batch],
+            token_budget=64, cost_fn=lambda p: len(p["x"]),
+            max_delay_ms=1.0)
+    try:
+        futures = [b.submit({"x": "y" * 8}) for _ in range(4)]
+        assert all(f.result(timeout=10)["ok"] for f in futures)
+    finally:
+        b.close()
+
+
+def test_construction_is_the_only_warning_site():
+    """The unified scheduler itself must stay warning-free — the
+    facades warn, the replacement doesn't."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        s = ContinuousBatchScheduler(token_budget=8, max_chunk=4)
+        s.plan_speculative(1, (2,), (4,))
