@@ -153,6 +153,19 @@ Rules (names are the ``check`` field of emitted violations):
     page. Genuinely non-arena ``.at`` updates in serving code suppress
     per line with a reason.
 
+``tenant-label-discipline``
+    Metric label sites (``.labels(...)``) and typed event emissions
+    (``emit("...", ...)``) in the multi-tenant planes — ``fleet/``,
+    ``serving/decode.py``, ``serving/batcher.py`` — without a
+    ``tenant=`` keyword. Noisy-neighbor isolation is only *provable*
+    if every observability series in the shared-pool path attributes
+    its samples to a tenant (docs/OBSERVABILITY.md "Tenant labels");
+    an unlabeled series silently merges all tenants and hides exactly
+    the starvation the quotas exist to prevent. Series that are
+    genuinely tenant-free (per-replica breaker gauges, aggregate
+    outcome counters that a tenant-split sibling series covers)
+    suppress per line with a reason naming the covering series.
+
 Tracing detection is local and conservative: functions decorated with
 ``jax.jit`` / ``partial(jax.jit, ...)``, functions passed to a
 ``jax.jit(...)`` call anywhere in the module, and everything nested
@@ -950,6 +963,50 @@ def _check_kv_alias(tree: ast.AST, path: str) -> List[Violation]:
     return out
 
 
+# multi-tenant observability: every label/emit site in these planes
+# must attribute to a tenant (or carry a reasoned suppression)
+_TENANT_LABEL_FILES = ("serving/decode.py", "serving/batcher.py")
+
+
+def _check_tenant_label_discipline(tree: ast.AST,
+                                   path: str) -> List[Violation]:
+    """``tenant-label-discipline``: see the module docstring. Matches
+    ``<anything>.labels(...)`` and ``emit("<type>", ...)`` /
+    ``<anything>.emit("<type>", ...)`` calls; only string-literal
+    event types are checked (computed types are a different smell)."""
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_labels = isinstance(func, ast.Attribute) \
+            and func.attr == "labels"
+        is_emit = ((isinstance(func, ast.Attribute)
+                    and func.attr == "emit")
+                   or (isinstance(func, ast.Name) and func.id == "emit"))
+        if not (is_labels or is_emit):
+            continue
+        if is_emit and not (node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)):
+            continue
+        if any(kw.arg == "tenant" for kw in node.keywords):
+            continue
+        what = ("metric .labels(...) site" if is_labels
+                else f"event emit({node.args[0].value!r}, ...)")
+        out.append(Violation(
+            check="tenant-label-discipline",
+            where=f"{path}:{node.lineno}",
+            message=f"{what} without a tenant= label in a multi-tenant "
+                    "plane — unlabeled series merge all tenants and "
+                    "hide noisy-neighbor starvation "
+                    "(docs/OBSERVABILITY.md 'Tenant labels'); add the "
+                    "tenant label, or mark the line 'graphcheck: "
+                    "ignore' with a reason naming the tenant-split "
+                    "series that covers it"))
+    return out
+
+
 def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     """Lint one module's source. ``path`` is used for reporting and
     for the ops-scoped rule (a path containing ``/ops/``)."""
@@ -977,6 +1034,9 @@ def lint_source(src: str, path: str = "<memory>") -> List[Violation]:
     if "perceiver_tpu/serving/" in norm and not norm.endswith(
             _KV_ALIAS_EXEMPT):
         violations.extend(_check_kv_alias(tree, path))
+    if "perceiver_tpu/fleet/" in norm \
+            or norm.endswith(_TENANT_LABEL_FILES):
+        violations.extend(_check_tenant_label_discipline(tree, path))
     if "perceiver_tpu/parallel/" in norm \
             or norm.endswith("perceiver_tpu/training/spmd.py"):
         violations.extend(_check_unsharded_pjit(tree, path))
@@ -1035,7 +1095,8 @@ ALL_RULES = ("jit-host-sync", "jit-python-rng-time", "ops-numpy-mix",
              "impl-field-validation", "serving-host-sync",
              "uncached-compile", "silent-swallow", "router-blocking-io",
              "distributed-blocking-io", "unsharded-pjit",
-             "metrics-conventions", "blocking-under-lock", "kv-alias")
+             "metrics-conventions", "blocking-under-lock", "kv-alias",
+             "tenant-label-discipline")
 
 
 def lint_paths(paths: Iterable[str]) -> Report:

@@ -342,12 +342,37 @@ def run_graph_checks(targets: Sequence[StepTarget] = CANONICAL_TARGETS,
             report.extend(vs)
             report.ran("cache_key_stability")
             fingerprints[target.name] = fp
-    if recompile and len(set(fingerprints.values())) < len(fingerprints):
-        dupes = {n: fp for n, fp in fingerprints.items()
-                 if list(fingerprints.values()).count(fp) > 1}
-        report.add(Violation(
-            check="recompile_budget", where=",".join(sorted(dupes)),
-            message=f"distinct targets share a step signature {dupes} — "
-                    "two canonical configs collapsed onto one compile "
-                    "key, so one of them is not being checked"))
+    if recompile:
+        # declared signature twins: a target whose whole point is that
+        # it lowers onto ANOTHER target's compile key (the multi-tenant
+        # decode round — tenancy is host-side state, so admitting a
+        # tenant must mint zero new executables). Equality is ASSERTED
+        # when both ends were lowered this run, and the twin is
+        # excluded from the distinct-targets collapse check below.
+        twins = {t.name: t.signature_twin for t in targets
+                 if t.signature_twin}
+        for name, twin in twins.items():
+            if name not in fingerprints or twin not in fingerprints:
+                continue  # partial run (e.g. a single-target tier)
+            if fingerprints[name] != fingerprints[twin]:
+                report.add(Violation(
+                    check="recompile_budget", where=name,
+                    message=f"declared signature twin of {twin!r} but "
+                            f"the fingerprints diverged "
+                            f"({fingerprints[name]} vs "
+                            f"{fingerprints[twin]}) — the twin config "
+                            "now compiles its own executable, which "
+                            "for the multi-tenant round means tenant "
+                            "admission costs a mid-traffic compile"))
+        primary = {n: fp for n, fp in fingerprints.items()
+                   if n not in twins}
+        if len(set(primary.values())) < len(primary):
+            dupes = {n: fp for n, fp in primary.items()
+                     if list(primary.values()).count(fp) > 1}
+            report.add(Violation(
+                check="recompile_budget", where=",".join(sorted(dupes)),
+                message=f"distinct targets share a step signature "
+                        f"{dupes} — two canonical configs collapsed "
+                        "onto one compile key, so one of them is not "
+                        "being checked"))
     return report

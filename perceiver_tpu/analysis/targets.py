@@ -121,6 +121,13 @@ class StepTarget:
     kind: str = "train"
     mesh: Optional[MeshSpec] = None
     replication_allow: Tuple[ReplicationAllow, ...] = ()
+    # the name of another canonical target this one MUST share its
+    # step signature with — a positive gate, not an allowlist entry:
+    # the recompile_budget pass asserts the two fingerprints are
+    # EQUAL (and excludes the twin from the distinct-targets collapse
+    # check). Used by the multi-tenant decode round, whose whole claim
+    # is that tenancy never mints a new compile key.
+    signature_twin: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -698,6 +705,62 @@ def _decode_batch_mlm_spmd():
                              attn_impl="reference")
 
 
+def _multitenant_qlens(streams: int, max_chunk: int):
+    """The per-slot qlens a mixed-TENANT round actually feeds: three
+    tenants (weights 2/1/1) share the step's token budget through the
+    same ``weighted_fair_shares`` split the continuous batcher's
+    per-tenant planner uses (``serving/batcher.py take(tenant_budgets=
+    ...)``) — each tenant prefills full chunks until its fair share is
+    spent, then its remaining rows decode one token. Deterministic by
+    construction (no RNG), so the target re-lowers byte-identically."""
+    import numpy as np
+
+    from perceiver_tpu.serving.tenancy import weighted_fair_shares
+
+    owners = ["a" if i < streams // 2 else
+              "b" if i < 3 * streams // 4 else "c"
+              for i in range(streams)]
+    budget = streams * max_chunk // 2
+    remaining = weighted_fair_shares(
+        budget, {"a": 2.0, "b": 1.0, "c": 1.0})
+    qlens = []
+    for tenant in owners:
+        q = max(1, min(max_chunk, remaining[tenant]))
+        remaining[tenant] = max(0, remaining[tenant] - q)
+        qlens.append(q)
+    return np.array(qlens, np.int32)
+
+
+def _decode_batch_mlm_multitenant(vocab: int = 10003, seq: int = 512,
+                                  num_pages: int = 64,
+                                  attn_impl: str = "pallas"):
+    """The canonical MULTI-TENANT decode round: same geometry as
+    ``decode_mixed_mlm_r8_p64x16_q8``, but the qlens are the
+    fair-share plan of three tenants sharing the step (see
+    ``_multitenant_qlens``). Tenancy is host-side state only — quota
+    ledgers, fair-share planning, and shed decisions all happen before
+    tokens reach the device — so this target MUST lower to the
+    byte-identical module of its single-tenant twin
+    (tests/test_graphcheck.py pins the fingerprint equality). The
+    pinned hbm budget is therefore the same O(1) gate: admitting a
+    tenant costs zero compiles and zero step-cost growth."""
+    task, batch = _decode_batch_mlm(vocab=vocab, seq=seq,
+                                    num_pages=num_pages,
+                                    attn_impl=attn_impl)
+    import jax.numpy as jnp
+
+    geometry = batch["geometry"]
+    batch["qlens"] = jnp.asarray(
+        _multitenant_qlens(geometry.max_streams, geometry.max_chunk))
+    return task, batch
+
+
+def _decode_batch_mlm_multitenant_spmd():
+    return _decode_batch_mlm_multitenant(vocab=8192, seq=256,
+                                         num_pages=48,
+                                         attn_impl="reference")
+
+
 def _decode_batch_mlm_spec():
     # the speculative verify executable: k=4 drafted lanes + feedback
     # fold 5 latent-rebuild windows per stream into the kernel row
@@ -716,6 +779,9 @@ DECODE_TARGETS = (
                build=_decode_batch_mlm, kind="decode"),
     StepTarget(name="decode_spec_mlm_r8_p64x16_q8_k4",
                build=_decode_batch_mlm_spec, kind="decode"),
+    StepTarget(name="decode_multitenant_mlm_r8_p64x16_q8",
+               build=_decode_batch_mlm_multitenant, kind="decode",
+               signature_twin="decode_mixed_mlm_r8_p64x16_q8"),
 )
 
 
@@ -768,6 +834,22 @@ SHARDED_TARGETS = (
                # kernel's fp32 online-softmax accumulator bit-for-bit
                # in tests — two QK^T and two PV dots per step (layer_1
                # + the scanned layer_n), ~9% of step dot-FLOPs each
+               dtype_allow=(
+                   DtypeAllow(
+                       dtype="f32", max_count=4,
+                       reason="reference paged-attention fp32 "
+                              "accumulation — parity twin of the "
+                              "Pallas kernel's fp32 online-softmax "
+                              "accumulator; production decode lowers "
+                              "the bf16 Pallas kernel instead"),)),
+    StepTarget(name="decode_multitenant_mlm_spmd_r8_p48x16_q8_dp2_tp2",
+               build=_decode_batch_mlm_multitenant_spmd, kind="decode",
+               signature_twin="decode_mixed_mlm_spmd_r8_p48x16_q8_dp2_tp2",
+               mesh=DP2_TP2,
+               replication_allow=_SPMD_MLM_EMBED_ALLOW,
+               # same reference-path fp32 parity twin as the other
+               # spmd decode targets — the multi-tenant qlens plan is
+               # host-side data, so the lowered dots are unchanged
                dtype_allow=(
                    DtypeAllow(
                        dtype="f32", max_count=4,

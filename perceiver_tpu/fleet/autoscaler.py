@@ -8,11 +8,40 @@ threshold trigger a resize, and every resize resets the streak — so a
 single bursty sample never flaps the fleet. Bounds are hard:
 ``min_replicas <= size <= max_replicas`` always (docs/SERVING.md
 "Fleet").
+
+Multi-tenancy: :func:`allocate_replicas` turns the router's observed
+per-tenant demand (``router.tenant_demand()``) into a per-tenant
+replica allocation over the current pool via the same deterministic
+largest-remainder arithmetic the decode planner uses — so capacity
+planning and token planning agree on what "fair share" means
+(docs/SERVING.md "Multi-tenancy").
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
+
+from perceiver_tpu.serving.tenancy import weighted_fair_shares
+
+
+def allocate_replicas(demand: Dict[str, float],
+                      replicas: int) -> Dict[str, int]:
+    """Split ``replicas`` across tenants proportionally to observed
+    ``demand`` (e.g. in-flight counts), deterministic largest-remainder.
+
+    Zero/negative demand entries still appear in the result (with 0
+    unless the floor-of-one pass can lift them); with no positive
+    demand at all, replicas split evenly so an idle fleet stays
+    balanced rather than collapsing onto one tenant.
+    """
+    if replicas < 0:
+        raise ValueError(f"replicas must be >= 0, got {replicas}")
+    if not demand:
+        return {}
+    weights = {t: max(0.0, float(d)) for t, d in demand.items()}
+    if not any(weights.values()):
+        weights = {t: 1.0 for t in weights}
+    return weighted_fair_shares(replicas, weights)
 
 
 class Autoscaler:
@@ -45,6 +74,17 @@ class Autoscaler:
 
     def bind(self, fleet) -> None:
         self._fleet = fleet
+
+    def allocation(self) -> Dict[str, int]:
+        """Per-tenant replica allocation for the current pool, from
+        the router's observed demand. Purely advisory (the router
+        still load-balances every request); deployments use it to
+        decide which tenants justify the next scale-up."""
+        fleet = self._fleet
+        if fleet is None:
+            raise RuntimeError("autoscaler not bound to a fleet")
+        demand = fleet.router.tenant_demand()
+        return allocate_replicas(demand, fleet.size())
 
     def tick(self) -> Optional[int]:
         """Sample once; returns the new size if this tick resized,

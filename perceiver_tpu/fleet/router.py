@@ -31,6 +31,19 @@ Routing policy (docs/SERVING.md "Fleet"):
   with a ``retry_after_s`` hint derived from the soonest breaker
   reopen.
 
+Multi-tenancy (docs/SERVING.md "Multi-tenancy"): when constructed
+with a :class:`~perceiver_tpu.serving.tenancy.TenantRegistry`, every
+``submit`` is admission-checked against the caller's tenant *before
+any replica is picked*: an exhausted in-flight cap or rate bucket
+raises ``Unavailable("tenant_quota")`` with a ``retry_after_s`` hint,
+costing zero compute and zero replica load. Best-effort tenants
+(``priority >= PRIORITY_BEST_EFFORT``) get fewer retry attempts, so
+under saturation their retries never crowd out critical tenants'.
+Requests routed for a named model only consider replicas advertising
+that model (replicas report ``models`` in status/dispatch replies);
+tenancy is host-side state only — the compiled executables and the
+RPC wire shape are tenant-blind.
+
 Idempotency note: a retry after a transport error can re-execute a
 dispatch whose first attempt actually completed server-side. Fleet
 dispatch is pure inference (no server-side state mutation), so
@@ -56,6 +69,11 @@ from perceiver_tpu.resilience.breaker import (
 )
 from perceiver_tpu.serving.errors import Unavailable
 from perceiver_tpu.serving.metrics import MetricsRegistry
+from perceiver_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    PRIORITY_BEST_EFFORT,
+    TenantRegistry,
+)
 
 _HEALTH_RANK = {"READY": 0, "DEGRADED": 1, "STARTING": 2,
                 "UNAVAILABLE": 3}
@@ -87,6 +105,10 @@ class _ReplicaState:
         self.inflight = 0
         self.draining = False
         self.health = "READY"
+        # None = "models unknown": the replica never advertised a model
+        # list, so it is assumed to serve everything (single-model
+        # fleets and plain fakes never pay the tenancy tax)
+        self.models: Optional[frozenset] = None
         self.accepts_trace = _accepts_trace(handle)
 
 
@@ -108,6 +130,8 @@ class Router:
         "*.inflight": "_lock",
         "*.draining": "_lock",
         "*.health": "_lock",
+        "*.models": "_lock",
+        "_tenant_inflight": "_lock",
     }
 
     def __init__(self, *, max_attempts: int = 4,
@@ -116,6 +140,7 @@ class Router:
                  breaker_reset_s: float = 1.0,
                  prober_interval_s: Optional[float] = 0.25,
                  metrics: Optional[MetricsRegistry] = None,
+                 tenancy: Optional[TenantRegistry] = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         if max_attempts < 1:
@@ -128,6 +153,8 @@ class Router:
         self._sleep = sleep
         self._lock = threading.Lock()
         self._replicas: Dict[str, _ReplicaState] = {}
+        self.tenancy = tenancy
+        self._tenant_inflight: Dict[str, int] = {}
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         m = self.metrics
         self._m_requests = m.counter(
@@ -148,6 +175,10 @@ class Router:
         self._m_breaker_state = m.gauge(
             "fleet_breaker_state",
             "per-replica router breaker: 0=closed 1=half_open 2=open")
+        self._m_tenant_requests = m.counter(
+            "fleet_tenant_requests_total",
+            "router submits per tenant, by outcome "
+            "(ok|unavailable|error|shed)")
         self._closed = threading.Event()
         self._prober: Optional[threading.Thread] = None
         if prober_interval_s:
@@ -168,25 +199,25 @@ class Router:
         with self._lock:
             self._replicas[rid] = _ReplicaState(rid, handle, breaker)
             self._m_size.set(len(self._replicas))
-        self._m_breaker_state.labels(replica=rid).set(
+        self._m_breaker_state.labels(replica=rid).set(  # graphcheck: ignore — per-replica breaker gauge; tenant split is fleet_tenant_requests_total
             _BREAKER_STATE_VALUES[breaker.state])
 
     def _on_transition(self, rid: str, old: str, new: str) -> None:
-        self._m_breaker_state.labels(replica=rid).set(
+        self._m_breaker_state.labels(replica=rid).set(  # graphcheck: ignore — per-replica breaker gauge; tenant split is fleet_tenant_requests_total
             _BREAKER_STATE_VALUES.get(new, 0.0))
         if new == OPEN:
             self._m_ejected.inc()
-            events_mod.emit("fleet_ejection", replica=rid)
+            events_mod.emit("fleet_ejection", replica=rid)  # graphcheck: ignore — fleet_ejection is replica-scoped (breaker state, not traffic)
         elif new == CLOSED and old != CLOSED:
             self._m_readmitted.inc()
-            events_mod.emit("fleet_readmission", replica=rid)
+            events_mod.emit("fleet_readmission", replica=rid)  # graphcheck: ignore — fleet_readmission is replica-scoped (breaker state, not traffic)
 
     def remove(self, rid: str) -> None:
         with self._lock:
             self._replicas.pop(rid, None)
             self._m_size.set(len(self._replicas))
-        self._m_inflight.labels(replica=rid).remove()
-        self._m_breaker_state.labels(replica=rid).remove()
+        self._m_inflight.labels(replica=rid).remove()  # graphcheck: ignore — per-replica gauge removal on membership change
+        self._m_breaker_state.labels(replica=rid).remove()  # graphcheck: ignore — per-replica gauge removal on membership change
 
     def replicas(self) -> List[str]:
         with self._lock:
@@ -218,13 +249,16 @@ class Router:
 
     # -- routing ----------------------------------------------------------
 
-    def _pick(self, exclude) -> Optional[_ReplicaState]:
+    def _pick(self, exclude,
+              model: Optional[str] = None) -> Optional[_ReplicaState]:
         key = lambda r: (_HEALTH_RANK.get(r.health, 3),  # noqa: E731
                          r.inflight, r.rid)
         with self._lock:
             avail = [r for r in self._replicas.values()
                      if r.rid not in exclude and not r.draining
-                     and _HEALTH_RANK.get(r.health, 3) <= 1]
+                     and _HEALTH_RANK.get(r.health, 3) <= 1
+                     and (model is None or r.models is None
+                          or model in r.models)]
             pool = [r for r in avail if r.breaker.state == CLOSED]
             best = min(pool, key=key) if pool else None
             if best is None:
@@ -238,13 +272,13 @@ class Router:
             if best is None:
                 return None
             best.inflight += 1
-            self._m_inflight.labels(replica=best.rid).set(best.inflight)
+            self._m_inflight.labels(replica=best.rid).set(best.inflight)  # graphcheck: ignore — per-replica inflight gauge; per-tenant demand is tenant_demand()
             return best
 
     def _release(self, state: _ReplicaState) -> None:
         with self._lock:
             state.inflight = max(0, state.inflight - 1)
-            self._m_inflight.labels(replica=state.rid).set(state.inflight)
+            self._m_inflight.labels(replica=state.rid).set(state.inflight)  # graphcheck: ignore — per-replica inflight gauge; per-tenant demand is tenant_demand()
 
     def _retry_after_hint(self) -> float:
         with self._lock:
@@ -253,7 +287,44 @@ class Router:
         open_hints = [h for h in hints if h > 0]
         return min(open_hints) if open_hints else 0.1
 
-    def submit(self, arrays: dict) -> dict:
+    # -- tenancy -----------------------------------------------------------
+
+    def _admit_tenant(self, tenant: str):
+        """Quota-check ``tenant`` BEFORE any replica is touched.
+
+        Raises ``Unavailable("tenant_quota")`` (with a retry hint) on
+        an exhausted in-flight cap or rate bucket; returns the tenant's
+        spec otherwise. Zero compute is spent on a shed request.
+        """
+        spec = self.tenancy.get(tenant)
+        if spec.max_inflight is not None:
+            with self._lock:
+                held = self._tenant_inflight.get(tenant, 0)
+            if held >= spec.max_inflight:
+                self._shed_tenant(tenant, retry_after_s=None)
+        ok, retry_after = self.tenancy.admit(tenant)
+        if not ok:
+            self._shed_tenant(tenant, retry_after_s=retry_after)
+        return spec
+
+    def _shed_tenant(self, tenant: str, *,
+                     retry_after_s: Optional[float]) -> None:
+        self._m_tenant_requests.labels(tenant=tenant,
+                                       outcome="shed").inc()
+        events_mod.emit("tenant_shed", tenant=tenant,
+                        reason="tenant_quota")
+        raise Unavailable("tenant_quota", retry_after_s=retry_after_s,
+                          tenant=tenant)
+
+    def tenant_demand(self) -> Dict[str, int]:
+        """Current router-side in-flight per tenant — the autoscaler's
+        per-tenant demand signal (tenants seen at least once persist
+        with 0 so demand decay is observable)."""
+        with self._lock:
+            return dict(self._tenant_inflight)
+
+    def submit(self, arrays: dict, *, tenant: Optional[str] = None,
+               model: Optional[str] = None) -> dict:
         """Dispatch one request; returns the replica's materialized
         outputs dict. Raises only typed serving errors.
 
@@ -264,7 +335,45 @@ class Router:
         spans from the reply, and stamps ``reply["trace_id"]`` — so a
         request killed mid-flight and retried on a sibling yields ONE
         trace with the failed hop and the retry visible.
+
+        Tenancy: ``tenant`` names the caller (defaults to the shared
+        ``default`` tenant); quota admission runs first and can raise
+        ``Unavailable("tenant_quota")`` before any replica dispatch.
+        ``model`` restricts routing to replicas advertising that model
+        and is forwarded on the wire so multi-model replicas dispatch
+        against the right param set.
         """
+        tenant = tenant or DEFAULT_TENANT
+        attempts = self.max_attempts
+        if self.tenancy is not None:
+            spec = self._admit_tenant(tenant)
+            if model is None:
+                model = spec.model
+            if spec.priority >= PRIORITY_BEST_EFFORT:
+                # best-effort retries must not crowd out critical
+                # tenants' attempts when the pool is saturated
+                attempts = max(1, self.max_attempts // 2)
+        if tenant != DEFAULT_TENANT or model is not None:
+            # stamp the wire envelope (shallow copy: caller's dict is
+            # caller-owned); replicas route "model" to the matching
+            # param set and label their shed/usage metrics by "tenant"
+            arrays = dict(arrays)
+            arrays["tenant"] = tenant
+            if model is not None:
+                arrays["model"] = model
+        with self._lock:
+            self._tenant_inflight[tenant] = (
+                self._tenant_inflight.get(tenant, 0) + 1)
+        try:
+            return self._submit_routed(arrays, tenant=tenant,
+                                       model=model, attempts=attempts)
+        finally:
+            with self._lock:
+                held = self._tenant_inflight.get(tenant, 0)
+                self._tenant_inflight[tenant] = max(0, held - 1)
+
+    def _submit_routed(self, arrays: dict, *, tenant: str,
+                       model: Optional[str], attempts: int) -> dict:
         ctxs = trace_mod.attached()
         if not ctxs:
             own = trace_mod.start_trace(origin="router")
@@ -273,11 +382,11 @@ class Router:
         wire = ctxs[0].wire() if ctxs else None
         exclude: set = set()
         last_unavailable: Optional[Unavailable] = None
-        for attempt in range(self.max_attempts):
+        for attempt in range(attempts):
             pick_start = time.monotonic()
-            state = self._pick(exclude)
+            state = self._pick(exclude, model)
             if state is None:
-                if attempt + 1 >= self.max_attempts:
+                if attempt + 1 >= attempts:
                     break
                 # transient no-candidate (e.g. every replica tried once
                 # while one was mid-swap): back off and retry the full
@@ -287,7 +396,7 @@ class Router:
                 continue
             for c in ctxs:
                 c.record("route", start=pick_start, replica=state.rid,
-                         attempt=attempt)
+                         attempt=attempt, tenant=tenant)
             hop_start = time.monotonic()
             try:
                 if wire is not None and state.accepts_trace:
@@ -298,7 +407,7 @@ class Router:
                 self._release(state)
                 state.breaker.record_failure()
                 exclude.add(state.rid)
-                self._m_retries.labels(cause="transport").inc()
+                self._m_retries.labels(cause="transport").inc()  # graphcheck: ignore — aggregate retry-cause series; retry trace spans carry tenant
                 for c in ctxs:
                     c.record("rpc_hop", start=hop_start,
                              replica=state.rid, ok=False,
@@ -307,7 +416,8 @@ class Router:
                 self._sleep(self.retry_backoff_s * (attempt + 1))
                 for c in ctxs:
                     c.record("retry", start=retry_start,
-                             cause="transport", attempt=attempt)
+                             cause="transport", attempt=attempt,
+                             tenant=tenant)
                 continue
             except Unavailable as e:
                 self._release(state)
@@ -315,18 +425,20 @@ class Router:
                 # typed and immediate — try a sibling, no ejection
                 last_unavailable = e
                 exclude.add(state.rid)
-                self._m_retries.labels(cause="unavailable").inc()
+                self._m_retries.labels(cause="unavailable").inc()  # graphcheck: ignore — aggregate retry-cause series; retry trace spans carry tenant
                 for c in ctxs:
                     c.record("rpc_hop", start=hop_start,
                              replica=state.rid, ok=False,
                              error="unavailable")
                     c.record("retry", cause="unavailable",
-                             attempt=attempt)
+                             attempt=attempt, tenant=tenant)
                 continue
             except Exception:
                 self._release(state)
                 state.breaker.record_failure()
-                self._m_requests.labels(outcome="error").inc()
+                self._m_requests.labels(outcome="error").inc()  # graphcheck: ignore — aggregate outcome series; tenant split is fleet_tenant_requests_total below
+                self._m_tenant_requests.labels(
+                    tenant=tenant, outcome="error").inc()
                 raise
             self._release(state)
             state.breaker.record_success()
@@ -345,14 +457,22 @@ class Router:
                 # a replica the reply just reported UNAVAILABLE
                 with self._lock:
                     state.health = reply.get("health", state.health)
-            self._m_requests.labels(outcome="ok").inc()
+                    models = reply.get("models")
+                    if models is not None:
+                        state.models = frozenset(models)
+            self._m_requests.labels(outcome="ok").inc()  # graphcheck: ignore — aggregate outcome series; tenant split is fleet_tenant_requests_total below
+            self._m_tenant_requests.labels(tenant=tenant,
+                                           outcome="ok").inc()
             return reply
-        self._m_requests.labels(outcome="unavailable").inc()
+        self._m_requests.labels(outcome="unavailable").inc()  # graphcheck: ignore — aggregate outcome series; tenant split is fleet_tenant_requests_total below
+        self._m_tenant_requests.labels(tenant=tenant,
+                                       outcome="unavailable").inc()
         retry_after = self._retry_after_hint()
         if last_unavailable is not None:
             retry_after = max(retry_after,
                               last_unavailable.retry_after_s)
-        raise Unavailable("fleet_saturated", retry_after_s=retry_after)
+        raise Unavailable("fleet_saturated", retry_after_s=retry_after,
+                          tenant=tenant)
 
     def occupancy(self) -> float:
         """Mean router-side in-flight per routable replica — the
@@ -388,6 +508,9 @@ class Router:
                     continue  # graphcheck: ignore — prober must not die
                 with self._lock:
                     state.health = status.get("health", state.health)
+                    models = status.get("models")
+                    if models is not None:
+                        state.models = frozenset(models)
 
     def close(self) -> None:
         self._closed.set()

@@ -97,7 +97,8 @@ def error_envelope(exc: BaseException) -> dict:
     if isinstance(exc, Unavailable):
         return {"type": "Unavailable", "reason": exc.reason,
                 "bucket": exc.bucket,
-                "retry_after_s": exc.retry_after_s}
+                "retry_after_s": exc.retry_after_s,
+                "tenant": exc.tenant}
     name = type(exc).__name__
     if name in ("RequestTooLarge", "CheckpointIntegrityError"):
         return {"type": name, "message": str(exc)}
@@ -113,7 +114,8 @@ def raise_remote_error(err: dict) -> None:
     if kind == "Unavailable":
         raise Unavailable(err.get("reason", "remote"),
                           bucket=err.get("bucket"),
-                          retry_after_s=err.get("retry_after_s", 0.0))
+                          retry_after_s=err.get("retry_after_s", 0.0),
+                          tenant=err.get("tenant"))
     if kind == "RequestTooLarge":
         from perceiver_tpu.serving.engine import RequestTooLarge
         raise RequestTooLarge(err.get("message", "request too large"))

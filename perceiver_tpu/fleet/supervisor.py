@@ -57,21 +57,40 @@ class RpcReplicaHandle:
     def status(self) -> dict:
         return self._client.call("status", timeout=self._control_timeout)
 
-    def update_version(self, version: str) -> dict:
+    # cutover ops only send "model" when the caller names one, so a
+    # legacy replica (or a fake handle without the kwarg) keeps
+    # speaking the single-model protocol unchanged
+
+    def update_version(self, version: str,
+                       model: Optional[str] = None) -> dict:
         # a cutover waits for in-flight work to quiesce; give it the
         # dispatch budget, not the control budget
+        if model is not None:
+            return self._client.call("update_version", version=version,
+                                     model=model)
         return self._client.call("update_version", version=version)
 
-    def stage_version(self, version: str) -> dict:
+    def stage_version(self, version: str,
+                      model: Optional[str] = None) -> dict:
         # phase 1 of the group two-phase cutover: a verified load is
         # disk-bound, so it gets the dispatch budget too
+        if model is not None:
+            return self._client.call("stage_version", version=version,
+                                     model=model)
         return self._client.call("stage_version", version=version)
 
-    def commit_version(self, version: str) -> dict:
+    def commit_version(self, version: str,
+                       model: Optional[str] = None) -> dict:
         # phase 2: quiesces like update_version — dispatch budget
+        if model is not None:
+            return self._client.call("commit_version", version=version,
+                                     model=model)
         return self._client.call("commit_version", version=version)
 
-    def abort_version(self) -> dict:
+    def abort_version(self, model: Optional[str] = None) -> dict:
+        if model is not None:
+            return self._client.call("abort_version", model=model,
+                                     timeout=self._control_timeout)
         return self._client.call("abort_version",
                                  timeout=self._control_timeout)
 
@@ -295,7 +314,7 @@ class Supervisor:
         with self._lock:
             self._procs.pop(rid, None)
             restarts = self._restarts.get(rid, 0)
-        events_mod.emit("replica_death", replica=rid, restarts=restarts)
+        events_mod.emit("replica_death", replica=rid, restarts=restarts)  # graphcheck: ignore — replica_death is process-lifecycle, not tenant traffic
         with self._lock:
             if restarts >= self.max_restarts:
                 self._poisoned.add(rid)
@@ -314,7 +333,7 @@ class Supervisor:
         with self._lock:
             self._procs[rid] = replacement
         self._on_change(rid, replacement.handle)
-        events_mod.emit("replica_respawn", replica=rid)
+        events_mod.emit("replica_respawn", replica=rid)  # graphcheck: ignore — replica_respawn is process-lifecycle, not tenant traffic
 
     @property
     def poisoned(self) -> List[str]:
@@ -408,8 +427,9 @@ class Fleet:
         else:
             self.router.add(rid, handle)
 
-    def submit(self, arrays: dict) -> dict:
-        return self.router.submit(arrays)
+    def submit(self, arrays: dict, *, tenant: Optional[str] = None,
+               model: Optional[str] = None) -> dict:
+        return self.router.submit(arrays, tenant=tenant, model=model)
 
     def size(self) -> int:
         return len(self.router.replicas())

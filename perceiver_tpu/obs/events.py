@@ -73,11 +73,17 @@ SCHEMA: Dict[str, Tuple[str, ...]] = {
     # close pair per stream; "tokens" = generated count at close.
     # stream_admitted fires when the unified scheduler grants a slot
     # + pages; prefill_complete when the last prompt chunk lands
-    # ("chunks" = chunked-prefill steps the prompt took).
-    "stream_open": ("stream",),
-    "stream_admitted": ("stream", "pages"),
-    "prefill_complete": ("stream", "prompt_tokens", "chunks"),
-    "stream_close": ("stream", "tokens"),
+    # ("chunks" = chunked-prefill steps the prompt took). Every
+    # stream event carries the owning tenant (docs/OBSERVABILITY.md
+    # "Tenant labels") so isolation is provable from the event log.
+    "stream_open": ("stream", "tenant"),
+    "stream_admitted": ("stream", "pages", "tenant"),
+    "prefill_complete": ("stream", "prompt_tokens", "chunks", "tenant"),
+    "stream_close": ("stream", "tokens", "tenant"),
+    # multi-tenancy (serving/tenancy.py): one event per shed decision
+    # attributing WHERE a tenant's excess load was dropped ("reason"
+    # from serving/errors.SHED_REASONS)
+    "tenant_shed": ("tenant", "reason"),
     # prefix caching (serving/prefix_cache.py): hit/miss at admission
     # lookup, publish when prefill hands full prompt-only pages back
     # to the index, evict when LRU reclaim frees index-only pages.

@@ -15,6 +15,9 @@ Layers, bottom to top:
   decode engine runs when ``DecodeGeometry.spec_k > 0``;
 - ``errors``  — the typed failure vocabulary (``Unavailable``,
   ``BatchError``) every layer speaks (docs/RESILIENCE.md);
+- ``tenancy`` — the multi-tenant registry: per-tenant quotas,
+  priority classes, and the weighted fair-share arithmetic the
+  router/arena/planner enforce (docs/SERVING.md "Multi-tenancy");
 - ``health``  — the health/readiness state machine the engine exports
   via metrics;
 - ``metrics`` — counters/gauges/latency histograms with Prometheus
@@ -51,9 +54,17 @@ from perceiver_tpu.serving.speculative import (  # noqa: F401
     speculative_accept,
 )
 from perceiver_tpu.serving.errors import (  # noqa: F401
+    SHED_REASONS,
     BatchError,
     ServingError,
     Unavailable,
+    retry_after_for,
+)
+from perceiver_tpu.serving.tenancy import (  # noqa: F401
+    DEFAULT_TENANT,
+    TenantRegistry,
+    TenantSpec,
+    weighted_fair_shares,
 )
 from perceiver_tpu.serving.health import (  # noqa: F401
     HealthMonitor,

@@ -44,11 +44,12 @@ import threading
 import time
 import warnings
 from concurrent.futures import Future
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from perceiver_tpu.obs import trace as trace_mod
 from perceiver_tpu.serving.errors import BatchError, ServingError, Unavailable
 from perceiver_tpu.serving.metrics import MetricsRegistry
+from perceiver_tpu.serving.tenancy import DEFAULT_TENANT, weighted_fair_shares
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,8 +157,8 @@ class MicroBatcher:
                 raise RuntimeError("batcher is closed")
             if len(self._queue) >= self.max_depth:
                 depth = len(self._queue)
-                self._m_shed.labels(reason="queue_full").inc()
-                self._m_served.labels(outcome="shed").inc()
+                self._m_shed.labels(reason="queue_full").inc()  # graphcheck: ignore — micro-batch plane (rectangular serve path) predates tenancy; decode plane carries serving_tenant_shed_total
+                self._m_served.labels(outcome="shed").inc()  # graphcheck: ignore — micro-batch plane; decode plane carries the tenant-split series
                 fut.set_result(Overloaded("queue_full", depth))
                 return fut
             deadline = (now + timeout_ms / 1000.0
@@ -217,7 +218,7 @@ class MicroBatcher:
             self._m_depth.set(0)
         err = Unavailable("shutting_down")
         for p in leftover:
-            self._m_served.labels(outcome="unavailable").inc()
+            self._m_served.labels(outcome="unavailable").inc()  # graphcheck: ignore — micro-batch plane; decode plane carries the tenant-split series
             p.future.set_exception(err)
 
     # -- worker side ------------------------------------------------------
@@ -268,8 +269,8 @@ class MicroBatcher:
         live: List[_Pending] = []
         for p in batch:
             if p.deadline is not None and now > p.deadline:
-                self._m_shed.labels(reason="deadline").inc()
-                self._m_served.labels(outcome="shed").inc()
+                self._m_shed.labels(reason="deadline").inc()  # graphcheck: ignore — micro-batch plane; decode plane carries serving_tenant_shed_total
+                self._m_served.labels(outcome="shed").inc()  # graphcheck: ignore — micro-batch plane; decode plane carries the tenant-split series
                 p.future.set_result(
                     Overloaded("deadline", len(batch)))
             else:
@@ -306,14 +307,14 @@ class MicroBatcher:
             outcome = ("unavailable" if isinstance(e, Unavailable)
                        else "error")
             for p in live:
-                self._m_served.labels(outcome=outcome).inc()
+                self._m_served.labels(outcome=outcome).inc()  # graphcheck: ignore — micro-batch plane; decode plane carries the tenant-split series
                 p.future.set_exception(err)
             return
         done = self._clock()
         self._m_batch.observe(float(len(live)))
         for p, r in zip(live, results):
             self._m_latency.observe(done - p.enqueued_at)
-            self._m_served.labels(outcome="ok").inc()
+            self._m_served.labels(outcome="ok").inc()  # graphcheck: ignore — micro-batch plane; decode plane carries the tenant-split series
             p.future.set_result(r)
 
 
@@ -323,6 +324,7 @@ class _Queued:
     cost: int
     enqueued_at: float
     deadline: Optional[float]
+    tenant: str = DEFAULT_TENANT
 
 
 class ContinuousBatchScheduler:
@@ -389,19 +391,28 @@ class ContinuousBatchScheduler:
         return spent == 0 or spent + cost <= budget
 
     def plan_chunks(self, decode_rows: int,
-                    prefill_remaining: Sequence[int]) -> List[int]:
+                    prefill_remaining: Sequence[int],
+                    prefill_tenants: Optional[Sequence[str]] = None,
+                    tenant_weights: Optional[Dict[str, float]] = None,
+                    ) -> List[int]:
         """Split one step's token budget: returns the prompt-token
         chunk for each prefilling row (FIFO order, aligned with
         ``prefill_remaining``). Decode rows pre-spend ``decode_rows``
         tokens; rows the leftover cannot reach get 0 (they idle this
-        step), except the head row, which always gets >= 1."""
+        step), except the head row, which always gets >= 1. With
+        ``prefill_tenants``, the leftover splits across tenants by
+        weighted fair share first (see :meth:`plan_speculative`)."""
         _, chunks = self.plan_speculative(decode_rows, (),
-                                          prefill_remaining)
+                                          prefill_remaining,
+                                          prefill_tenants,
+                                          tenant_weights)
         return chunks
 
     def plan_speculative(self, decode_rows: int,
                          spec_requests: Sequence[int],
                          prefill_remaining: Sequence[int],
+                         prefill_tenants: Optional[Sequence[str]] = None,
+                         tenant_weights: Optional[Dict[str, float]] = None,
                          ) -> Tuple[List[int], List[int]]:
         """Speculative-aware budget split for one step.
 
@@ -414,6 +425,17 @@ class ContinuousBatchScheduler:
         handed to prefilling rows exactly as :meth:`plan_chunks`
         (which is the ``spec_requests=()`` special case). Returns
         ``(grants, chunks)`` aligned with the two input sequences.
+
+        With ``prefill_tenants`` (one tenant per prefilling row), the
+        leftover prefill budget first splits across the tenants
+        actually waiting — proportional to ``tenant_weights``
+        (:func:`~perceiver_tpu.serving.tenancy.weighted_fair_shares`,
+        weight 1.0 when unlisted) — and each tenant's rows draw FIFO
+        from their tenant's share. A second work-conserving pass hands
+        any unclaimed share back out FIFO, so fair-share costs nothing
+        when only one tenant is hungry, but a flood tenant's prompts
+        can never consume a waiting neighbour's slice. The global
+        head row still always advances >= 1 token (no-livelock).
         """
         budget = self.token_budget
         if budget is None:
@@ -425,13 +447,40 @@ class ContinuousBatchScheduler:
             g = min(int(req), left)
             grants.append(g)
             left -= g
+        caps: Optional[Dict[str, int]] = None
+        if prefill_tenants is not None and prefill_remaining:
+            if len(prefill_tenants) != len(prefill_remaining):
+                raise ValueError(
+                    f"{len(prefill_tenants)} tenants for "
+                    f"{len(prefill_remaining)} prefill rows")
+            weights = {
+                t: (tenant_weights or {}).get(t, 1.0)
+                for t in prefill_tenants
+            }
+            caps = weighted_fair_shares(left, weights)
         chunks: List[int] = []
         for i, rem in enumerate(prefill_remaining):
             c = min(int(rem), self.max_chunk, left)
+            if caps is not None:
+                c = min(c, caps[prefill_tenants[i]])
             if i == 0 and rem > 0:
                 c = max(c, 1)
             chunks.append(c)
+            if caps is not None:
+                caps[prefill_tenants[i]] = max(
+                    0, caps[prefill_tenants[i]] - c)
             left = max(0, left - c)
+        if caps is not None and left > 0:
+            # work-conserving second pass: shares nobody could use
+            # (short prompts, absent tenants) go back out FIFO
+            for i, rem in enumerate(prefill_remaining):
+                extra = min(int(rem) - chunks[i],
+                            self.max_chunk - chunks[i], left)
+                if extra > 0:
+                    chunks[i] += extra
+                    left -= extra
+                if left <= 0:
+                    break
         return grants, chunks
 
     @property
@@ -440,18 +489,28 @@ class ContinuousBatchScheduler:
             return len(self._queue)
 
     def offer(self, item, *, cost: int,
-              deadline: Optional[float] = None) -> bool:
+              deadline: Optional[float] = None,
+              tenant: str = DEFAULT_TENANT) -> bool:
         """Enqueue one entry; False = queue full (caller sheds)."""
         with self._lock:
             if len(self._queue) >= self.max_depth:
                 return False
             self._queue.append(_Queued(item, int(cost), self._clock(),
-                                       deadline))
+                                       deadline, tenant))
             self._m_depth.set(len(self._queue))
         return True
 
+    def tenant_queued_cost(self) -> Dict[str, int]:
+        """Summed queued cost per tenant (quota pre-admission input)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for e in self._queue:
+                out[e.tenant] = out.get(e.tenant, 0) + e.cost
+            return out
+
     def take(self, *, budget: int, slots: int,
-             now: Optional[float] = None):
+             now: Optional[float] = None,
+             tenant_budgets: Optional[Dict[str, int]] = None):
         """Pop the admissible FIFO prefix: entries admit while ``slots``
         remain and their cost fits the remaining ``budget``; expired
         heads shed along the way. Returns ``(admitted, shed)`` items.
@@ -463,11 +522,22 @@ class ContinuousBatchScheduler:
         held only by the prefix index are reclaimed on demand, and a
         cached-prefix hit draws fewer pages than the conservative
         per-item cost, so charging full cost here stays safe).
+
+        ``tenant_budgets`` maps a tenant to the pages it may still
+        claim (absent tenant = unlimited; the dict is decremented in
+        place as entries admit). An entry whose tenant is out of
+        budget **defers** — it stays queued in order, and the scan
+        moves past it — instead of head-blocking the whole queue, so
+        one tenant's flood can never starve a neighbour's admission.
+        Order within a tenant is still FIFO: once one of a tenant's
+        entries defers, all its later entries defer this round too.
         """
         if now is None:
             now = self._clock()
         admitted, shed = [], []
         with self._lock:
+            deferred: List[_Queued] = []
+            over_quota: set = set()
             while self._queue:
                 head = self._queue[0]
                 # expired heads shed even when no slot/budget is free —
@@ -479,10 +549,22 @@ class ContinuousBatchScheduler:
                     continue
                 if slots <= 0 or head.cost > budget:
                     break
+                if tenant_budgets is not None:
+                    tb = tenant_budgets.get(head.tenant)
+                    if head.tenant in over_quota \
+                            or (tb is not None and head.cost > tb):
+                        over_quota.add(head.tenant)
+                        deferred.append(self._queue.popleft())
+                        continue
                 self._queue.popleft()
                 admitted.append(head.item)
                 budget -= head.cost
                 slots -= 1
+                if tenant_budgets is not None \
+                        and head.tenant in tenant_budgets:
+                    tenant_budgets[head.tenant] -= head.cost
+            for e in reversed(deferred):
+                self._queue.appendleft(e)
             self._m_depth.set(len(self._queue))
         return admitted, shed
 

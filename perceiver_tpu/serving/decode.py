@@ -101,6 +101,11 @@ from perceiver_tpu.serving.speculative import (
     SpeculativeConfig,
     greedy_accept,
 )
+from perceiver_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    TenantRegistry,
+    TenantSpec,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -526,10 +531,12 @@ class _Stream:
                  "pages", "fed", "next_input", "generated", "tokens_q",
                  "done", "outcome", "error", "ttft_s", "submitted_at",
                  "prefill_chunks", "cached_tokens", "shared_pages",
-                 "draft_pages", "draft_fed", "spec_on", "acc_ema")
+                 "draft_pages", "draft_fed", "spec_on", "acc_ema",
+                 "tenant")
 
     def __init__(self, sid, prompt, max_new, pages_needed, on_token,
-                 ctx, now, deadline):
+                 ctx, now, deadline, tenant=DEFAULT_TENANT):
+        self.tenant = tenant
         self.sid = sid
         self.seq = int(sid[1:])  # admission order (FIFO chunk planning)
         self.prefill_chunks = 0
@@ -635,6 +642,9 @@ class DecodeEngine:
         "_draft_tables": "_lock",
         "_draft_lengths": "_lock",
         "_draft_dirty": "_lock",
+        # per-tenant page accounting: charged at admission, credited
+        # at finish — the quota enforcement ledger
+        "_tenant_pages": "_lock",
     }
 
     def __init__(self, task, params=None, *,
@@ -647,6 +657,7 @@ class DecodeEngine:
                  token_budget: Optional[int] = None,
                  prefix_cache: Optional[PrefixCacheConfig] = None,
                  speculative: Optional[SpeculativeConfig] = None,
+                 tenancy: Optional[TenantRegistry] = None,
                  auto_step: bool = True,
                  seed: int = 0):
         import jax
@@ -663,6 +674,10 @@ class DecodeEngine:
         self.geometry = geometry
         self.policy = policy
         self.speculative = speculative
+        # host-side tenancy: quotas/weights only — never a compiled
+        # shape, so the exec-cache key is identical with it on or off
+        self.tenancy = tenancy
+        self._tenant_pages: Dict[str, int] = {}
         # per-step token pacing: every decode row costs 1, the rest
         # goes to prefill chunks — host-side policy only, never a
         # compiled shape, so it is tunable without a recompile
@@ -729,6 +744,15 @@ class DecodeEngine:
         self._m_spec_fallback = m.counter(
             "serving_spec_fallback_total",
             "streams dropped to plain decode on acceptance collapse")
+        self._m_tenant_pages = m.gauge(
+            "serving_tenant_pages_used",
+            "KV pages charged to each tenant's quota")
+        self._m_tenant_shed = m.counter(
+            "serving_tenant_shed_total",
+            "streams shed, by tenant and reason")
+        self._m_tenant_tokens = m.counter(
+            "serving_tenant_tokens_total",
+            "generated tokens emitted, by tenant")
         self._m_pool_gauges = PagePoolGauges(m, arena="target")
 
         r = geometry.max_streams
@@ -767,7 +791,7 @@ class DecodeEngine:
             label=f"decode:{geometry.descriptor}",
             extra_key=(geometry.descriptor,))
         if self.exec_cache is not None:
-            events_mod.emit("exec_cache",
+            events_mod.emit("exec_cache",  # graphcheck: ignore — exec_cache is bucket-scoped (compile plane, shared across tenants by design)
                             bucket=f"decode:{geometry.descriptor}",
                             hit=bool(info["hit"]))
         # warmup step with every slot idle: the steady state then
@@ -852,7 +876,7 @@ class DecodeEngine:
             label=f"draft:{g.descriptor}",
             extra_key=("draft", g.descriptor))
         if self.exec_cache is not None:
-            events_mod.emit("exec_cache",
+            events_mod.emit("exec_cache",  # graphcheck: ignore — exec_cache is bucket-scoped (compile plane, shared across tenants by design)
                             bucket=f"draft:{g.descriptor}",
                             hit=bool(info["hit"]))
         carry, out = self._draft_exe(
@@ -862,15 +886,24 @@ class DecodeEngine:
 
     # -- submission -------------------------------------------------------
 
+    def _tenant_spec(self, tenant: str) -> TenantSpec:
+        if self.tenancy is None:
+            return TenantSpec(tenant=tenant)
+        return self.tenancy.get(tenant)
+
     def submit(self, prompt_ids, *, max_new_tokens: int,
                timeout_ms: Optional[float] = None,
                on_token: Optional[Callable[[int], None]] = None,
-               trace: Optional[trace_mod.TraceContext] = None
+               trace: Optional[trace_mod.TraceContext] = None,
+               tenant: Optional[str] = None
                ) -> StreamHandle:
         """Enqueue one stream. Raises :class:`RequestTooLarge` when the
-        request can never fit this engine's geometry; resolves the
-        handle to a typed ``Overloaded`` when capacity is transiently
-        unavailable (queue full / admission deadline)."""
+        request can never fit this engine's geometry (or its tenant's
+        page quota); raises ``Unavailable("tenant_quota")`` — before
+        any compute — when the tenant's held + queued pages leave no
+        room; resolves the handle to a typed ``Overloaded`` when
+        capacity is transiently unavailable (queue full / admission
+        deadline)."""
         g = self.geometry
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -897,6 +930,12 @@ class DecodeEngine:
                 f"request needs {pages_needed} pages, pool has only "
                 f"{g.allocatable_pages} allocatable "
                 f"({g.num_pages} minus the reserved trash page)")
+        tenant = tenant or DEFAULT_TENANT
+        tspec = self._tenant_spec(tenant)
+        if tspec.max_pages is not None and pages_needed > tspec.max_pages:
+            raise RequestTooLarge(
+                f"request needs {pages_needed} pages but tenant "
+                f"{tenant!r} is capped at {tspec.max_pages}")
         now = time.monotonic()
         ctx = trace if trace is not None \
             else trace_mod.start_trace(origin="decode")
@@ -907,13 +946,32 @@ class DecodeEngine:
                 raise RuntimeError("decode engine is closed")
             if self._failed is not None:
                 raise Unavailable("decode_engine_failed")
+            if tspec.max_pages is not None:
+                # quota exhaustion sheds HERE — before a slot, a page,
+                # or a single device token is spent on the request.
+                # held + queued both charge, so a flood tenant cannot
+                # park unbounded work in the admission queue either.
+                charged = (self._tenant_pages.get(tenant, 0)
+                           + self._queue.tenant_queued_cost()
+                           .get(tenant, 0))
+                if charged + pages_needed > tspec.max_pages:
+                    self._m_tenant_shed.labels(
+                        tenant=tenant, reason="tenant_quota").inc()
+                    events_mod.emit("tenant_shed", tenant=tenant,
+                                    reason="tenant_quota")
+                    raise Unavailable("tenant_quota", tenant=tenant)
             self._seq += 1
             stream = _Stream(f"s{self._seq}", prompt, int(max_new_tokens),
-                             pages_needed, on_token, ctx, now, deadline)
+                             pages_needed, on_token, ctx, now, deadline,
+                             tenant=tenant)
             handle = StreamHandle(stream, self)
             if not self._queue.offer(stream, cost=pages_needed,
-                                     deadline=deadline):
-                self._m_shed.labels(reason="queue_full").inc()
+                                     deadline=deadline, tenant=tenant):
+                self._m_shed.labels(reason="queue_full").inc()  # graphcheck: ignore — aggregate shed counter predates tenancy; the tenant split rides serving_tenant_shed_total below
+                self._m_tenant_shed.labels(
+                    tenant=tenant, reason="queue_full").inc()
+                events_mod.emit("tenant_shed", tenant=tenant,
+                                reason="queue_full")
                 self._resolve_shed(stream, Overloaded(
                     "queue_full", self._queue.depth))
                 return handle
@@ -930,10 +988,26 @@ class DecodeEngine:
         budget = self.pool.free_pages
         if self.prefix_index is not None:
             budget += self.prefix_index.evictable_pages()
+        tenant_budgets = None
+        if self.tenancy is not None:
+            # remaining per-tenant page headroom: entries of a tenant
+            # that is out of headroom defer inside take() without
+            # blocking anyone else's admission
+            tenant_budgets = {}
+            for t in self._queue.tenant_queued_cost():
+                cap = self._tenant_spec(t).max_pages
+                if cap is not None:
+                    tenant_budgets[t] = max(
+                        0, cap - self._tenant_pages.get(t, 0))
         admitted, shed = self._queue.take(
-            budget=budget, slots=free_slots, now=now)
+            budget=budget, slots=free_slots, now=now,
+            tenant_budgets=tenant_budgets)
         for stream in shed:
-            self._m_shed.labels(reason="deadline").inc()
+            self._m_shed.labels(reason="deadline").inc()  # graphcheck: ignore — aggregate shed counter predates tenancy; the tenant split rides serving_tenant_shed_total below
+            self._m_tenant_shed.labels(
+                tenant=stream.tenant, reason="deadline").inc()
+            events_mod.emit("tenant_shed", tenant=stream.tenant,
+                            reason="deadline")
             self._resolve_shed(stream, Overloaded(
                 "deadline", self._queue.depth))
         for stream in admitted:
@@ -954,12 +1028,12 @@ class DecodeEngine:
                 if cached > 0:
                     self._m_prefix_hits.inc()
                     self._m_prefix_hit_tokens.inc(cached)
-                    events_mod.emit("prefix_cache_hit",
+                    events_mod.emit("prefix_cache_hit",  # graphcheck: ignore — stream-scoped; stream->tenant join via the stream_open event
                                     stream=stream.sid, tokens=cached,
                                     pages=len(shared))
                 else:
                     self._m_prefix_misses.inc()
-                    events_mod.emit("prefix_cache_miss",
+                    events_mod.emit("prefix_cache_miss",  # graphcheck: ignore — stream-scoped; stream->tenant join via the stream_open event
                                     stream=stream.sid)
             # the cached span is page-aligned and strictly shorter
             # than the prompt, so >= 1 private page is always needed
@@ -971,7 +1045,7 @@ class DecodeEngine:
                     private_needed - self.pool.free_pages)
                 if evicted:
                     self._m_prefix_evicted.inc(evicted)
-                    events_mod.emit("prefix_cache_evict", pages=evicted)
+                    events_mod.emit("prefix_cache_evict", pages=evicted)  # graphcheck: ignore — LRU reclaim frees index-only pages owned by no tenant
             private = self.pool.alloc(private_needed)
             for p in private:
                 # CoW discipline: every page this stream will write is
@@ -1006,12 +1080,23 @@ class DecodeEngine:
             # had already written those positions
             self._lengths[slot] = stream.cached_tokens
             self._dirty = True
+            # quota ledger charges the conservative pages_needed (what
+            # admission budgeted), not the prefix-shared actual — two
+            # tenants sharing a prefix must not double-spend headroom
+            self._tenant_pages[stream.tenant] = (
+                self._tenant_pages.get(stream.tenant, 0)
+                + stream.pages_needed)
+            self._m_tenant_pages.labels(tenant=stream.tenant).set(
+                self._tenant_pages[stream.tenant])
             if stream.ctx is not None:
                 stream.ctx.record("queue_wait", start=stream.enqueued_at,
-                                  end=now, stream=stream.sid)
-            events_mod.emit("stream_open", stream=stream.sid)
+                                  end=now, stream=stream.sid,
+                                  tenant=stream.tenant)
+            events_mod.emit("stream_open", stream=stream.sid,
+                            tenant=stream.tenant)
             events_mod.emit("stream_admitted", stream=stream.sid,
-                            pages=len(stream.pages))
+                            pages=len(stream.pages),
+                            tenant=stream.tenant)
             self._m_active.set(
                 sum(1 for s in self._streams if s is not None))
             self._m_free_pages.set(self.pool.free_pages)
@@ -1057,9 +1142,17 @@ class DecodeEngine:
                     if s.spec_on and kd >= 1:
                         spec_cand.append((i, s))
                         desires.append(kd)
+            prefill_tenants = None
+            tenant_weights = None
+            if self.tenancy is not None and prefill_live:
+                prefill_tenants = [s.tenant for _, s in prefill_live]
+                tenant_weights = {
+                    t: self._tenant_spec(t).weight
+                    for t in set(prefill_tenants)}
             grants, plan = self._queue.plan_speculative(
                 len(decode_live), desires,
-                [len(s.prompt) - s.fed for _, s in prefill_live])
+                [len(s.prompt) - s.fed for _, s in prefill_live],
+                prefill_tenants, tenant_weights)
             props: Dict[int, List[int]] = {}
             if spec_cand:
                 cand = [(i, s, k) for (i, s), k in zip(spec_cand, grants)
@@ -1117,7 +1210,8 @@ class DecodeEngine:
                     self._m_prefill_tokens.inc(c)
                     if s.ctx is not None:
                         s.ctx.record("prefill_chunk", start=t0, end=t1,
-                                     stream=s.sid, chunk=c, fed=s.fed)
+                                     stream=s.sid, chunk=c, fed=s.fed,
+                                     tenant=s.tenant)
                     if s.fed < len(s.prompt):
                         continue
                     # the chunk that consumed the last prompt token
@@ -1125,14 +1219,15 @@ class DecodeEngine:
                     events_mod.emit("prefill_complete", stream=s.sid,
                                     prompt_tokens=len(s.prompt),
                                     chunks=s.prefill_chunks,
-                                    cached_tokens=s.cached_tokens)
+                                    cached_tokens=s.cached_tokens,
+                                    tenant=s.tenant)
                     if self.prefix_index is not None:
                         # every full prompt-only page is now written;
                         # publish the ones the index doesn't know yet
                         pub = self.prefix_index.publish(
                             s.prompt, s.pages)
                         if pub:
-                            events_mod.emit("prefix_cache_publish",
+                            events_mod.emit("prefix_cache_publish",  # graphcheck: ignore — stream-scoped; stream->tenant join via the stream_open event
                                             stream=s.sid, pages=pub)
                         self._m_prefix_pages.set(
                             self.prefix_index.pages_indexed)
@@ -1147,7 +1242,8 @@ class DecodeEngine:
                         emitted = [int(next_tok[i])]
                         if s.ctx is not None:
                             s.ctx.record("decode_step", start=t0,
-                                         end=t1, stream=s.sid)
+                                         end=t1, stream=s.sid,
+                                         tenant=s.tenant)
                 for tok in emitted:
                     s.generated.append(tok)
                     if s.ttft_s is None:
@@ -1157,7 +1253,8 @@ class DecodeEngine:
                         s.ctx.record("token_emit", start=t1, end=t1,
                                      stream=s.sid,
                                      index=len(s.generated) - 1)
-                    self._m_tokens.inc()
+                    self._m_tokens.inc()  # graphcheck: ignore — aggregate token counter predates tenancy; the tenant split rides serving_tenant_tokens_total below
+                    self._m_tenant_tokens.labels(tenant=s.tenant).inc()
                     emits.append((s, tok))
                 s.next_input = emitted[-1]
                 if len(s.generated) >= s.max_new:
@@ -1296,7 +1393,7 @@ class DecodeEngine:
         self._m_spec_draft.inc(kg)
         self._m_spec_accepted.inc(a)
         self._m_spec_verify.inc()
-        events_mod.emit("spec_verify", stream=s.sid, drafted=kg,
+        events_mod.emit("spec_verify", stream=s.sid, drafted=kg,  # graphcheck: ignore — stream-scoped; stream->tenant join via the stream_open event
                         accepted=a)
         if s.ctx is not None:
             s.ctx.record("verify", start=t0, end=t1, stream=s.sid,
@@ -1313,7 +1410,7 @@ class DecodeEngine:
             self._draft_dirty = True
             self._m_spec_fallback.inc()
             self._m_draft_gauges.update(self.draft_pool)
-            events_mod.emit("spec_fallback", stream=s.sid,
+            events_mod.emit("spec_fallback", stream=s.sid,  # graphcheck: ignore — stream-scoped; stream->tenant join via the stream_open event
                             acceptance=round(s.acc_ema, 4))
         return emitted
 
@@ -1347,6 +1444,13 @@ class DecodeEngine:
     def _finish_locked(self, s: _Stream, how: str) -> None:
         if s.slot >= 0:
             self.pool.free(s.pages)
+            held = self._tenant_pages.get(s.tenant, 0) - s.pages_needed
+            if held > 0:
+                self._tenant_pages[s.tenant] = held
+            else:
+                self._tenant_pages.pop(s.tenant, None)
+            self._m_tenant_pages.labels(tenant=s.tenant).set(
+                max(0, held))
             self._streams[s.slot] = None
             self._tables[s.slot, :] = 0
             self._lengths[s.slot] = 0
@@ -1363,15 +1467,15 @@ class DecodeEngine:
             self._m_free_pages.set(self.pool.free_pages)
             self._m_pool_gauges.update(self.pool)
         events_mod.emit("stream_close", stream=s.sid,
-                        tokens=len(s.generated))
-        self._m_streams.labels(outcome=how).inc()
+                        tokens=len(s.generated), tenant=s.tenant)
+        self._m_streams.labels(outcome=how).inc()  # graphcheck: ignore — aggregate outcome counter predates tenancy; per-tenant accounting rides serving_tenant_* series
         s.outcome = DecodeResult(
             tokens=list(s.generated), prompt_len=len(s.prompt),
             finished=how, ttft_s=s.ttft_s,
             cached_tokens=s.cached_tokens)
 
     def _resolve_shed(self, s: _Stream, overloaded: Overloaded) -> None:
-        self._m_streams.labels(outcome="shed").inc()
+        self._m_streams.labels(outcome="shed").inc()  # graphcheck: ignore — aggregate outcome counter predates tenancy; per-tenant sheds ride serving_tenant_shed_total at the callers
         s.outcome = overloaded
         s.tokens_q.put(_SENTINEL)
         s.done.set()
@@ -1481,6 +1585,12 @@ class DecodeEngine:
                 else 0.0,
                 "draft_free_pages": self.draft_pool.free_pages,
             }
+
+    def tenant_page_usage(self) -> Dict[str, int]:
+        """Pages currently charged per tenant (the quota ledger) —
+        chaos/bench gates sample this to prove isolation held."""
+        with self._lock:
+            return dict(self._tenant_pages)
 
     @property
     def active_streams(self) -> int:

@@ -6,11 +6,56 @@ with one of these typed exceptions — never a raw internal traceback
 and never a hang. API layers map them 1:1 onto transport codes
 (``Unavailable`` → 503 + Retry-After, ``BatchError`` → 500,
 ``RequestTooLarge`` → 413).
+
+Shed vocabulary: every ``Unavailable`` raise site across the router,
+batcher, scheduler, and replicas names its cause from ONE fixed
+vocabulary (:data:`SHED_REASONS`) so operators and retry policies can
+match on reasons instead of prose. Each entry carries a default
+``retry_after_s`` (:func:`retry_after_for`) so the hint is populated
+consistently even at sites with no breaker to derive it from. Decode
+shed reasons cross the fleet boundary prefixed (``decode_<reason>``,
+e.g. ``decode_queue_full``) — the prefix marks which plane shed.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Tuple
+
+#: the one shed vocabulary: reason -> default retry_after_s hint.
+#: Sites with a better signal (breaker cooldown, rate bucket refill)
+#: override the default; sites without one use it as-is.
+SHED_REASONS = {
+    "fleet_saturated": 0.1,   # router: no routable replica remains
+    "tenant_quota": 0.05,     # tenant over inflight/rate/page quota
+    "shutting_down": 0.0,     # engine/batcher close() stranded it
+    "updating": 0.05,         # replica mid-param-cutover
+    "queue_full": 0.05,       # admission queue at max_depth
+    "deadline": 0.0,          # per-request deadline expired queued
+    "decode_engine_failed": 0.0,  # stepped executable died mid-flight
+    "unknown_model": 0.0,     # no replica hosts the requested model
+}
+
+_DECODE_PREFIX = "decode_"
+
+
+def known_reason(reason: str) -> bool:
+    """Is ``reason`` in the shed vocabulary? ``decode_<reason>``
+    prefixed forms are part of it (a decode-plane shed crossing the
+    fleet RPC keeps its plane marker)."""
+    if reason in SHED_REASONS:
+        return True
+    return (reason.startswith(_DECODE_PREFIX)
+            and reason[len(_DECODE_PREFIX):] in SHED_REASONS)
+
+
+def retry_after_for(reason: str) -> float:
+    """The vocabulary's default ``retry_after_s`` for ``reason``
+    (0.0 for reasons outside the vocabulary)."""
+    if reason in SHED_REASONS:
+        return SHED_REASONS[reason]
+    if reason.startswith(_DECODE_PREFIX):
+        return SHED_REASONS.get(reason[len(_DECODE_PREFIX):], 0.0)
+    return 0.0
 
 
 class ServingError(RuntimeError):
@@ -19,13 +64,22 @@ class ServingError(RuntimeError):
 
 class Unavailable(ServingError):
     """The request was rejected without any compute being spent on it
-    — its bucket's circuit breaker is open (or the engine is not
-    ready). ``retry_after_s`` is the breaker's cooldown remainder."""
+    — its bucket's circuit breaker is open, the engine is not ready,
+    or the tenant is over quota. ``reason`` names the cause from
+    :data:`SHED_REASONS`; ``retry_after_s`` defaults to the
+    vocabulary's hint for that reason when the raise site has no
+    better signal; ``tenant`` attributes the shed when the cause is
+    tenant-scoped (it survives the fleet RPC envelope)."""
 
     def __init__(self, reason: str,
                  bucket: Optional[Tuple[int, Optional[int]]] = None,
-                 retry_after_s: float = 0.0):
+                 retry_after_s: Optional[float] = None,
+                 tenant: Optional[str] = None):
+        if retry_after_s is None:
+            retry_after_s = retry_after_for(reason)
         detail = f"unavailable ({reason})"
+        if tenant is not None:
+            detail += f" tenant={tenant}"
         if bucket is not None:
             detail += f" bucket={bucket}"
         if retry_after_s > 0:
@@ -34,6 +88,7 @@ class Unavailable(ServingError):
         self.reason = reason
         self.bucket = bucket
         self.retry_after_s = retry_after_s
+        self.tenant = tenant
 
 
 class BatchError(ServingError):

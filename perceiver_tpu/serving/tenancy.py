@@ -1,0 +1,204 @@
+"""Multi-tenant registry, quotas, and fair-share arithmetic.
+
+One pool of chips serves many tenants (docs/SERVING.md
+"Multi-tenancy"), and the isolation contract is enforced at every
+contended resource, all host-side:
+
+- the **router** admits per tenant (in-flight cap + token-bucket
+  rate) *before* any replica is picked — quota exhaustion is a typed
+  ``Unavailable("tenant_quota")`` with ``retry_after_s``, never a
+  queued request;
+- the **decode arena** budgets KV pages per tenant
+  (``serving/decode.py``): a flooding tenant's streams defer in the
+  admission queue without blocking anyone else's, and its page
+  holdings can never exceed ``max_pages``;
+- the **step planner** splits the prefill token budget across tenants
+  by weight (:func:`weighted_fair_shares`), so one tenant's long
+  prompts cannot starve another's chunks.
+
+Tenancy never touches a compiled shape: the stepped executable's
+signature, the exec-cache key, and every pinned analysis budget are
+byte-identical with the registry on or off — exactly the prefix-cache
+discipline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: streams submitted without a tenant land here (uncapped by default)
+DEFAULT_TENANT = "default"
+
+#: priority classes, lowest number = most important (docs/SERVING.md)
+PRIORITY_CRITICAL = 0
+PRIORITY_STANDARD = 1
+PRIORITY_BEST_EFFORT = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's contract with the pool. ``None`` caps = unlimited.
+
+    ``model`` names the param set this tenant's requests route to (a
+    :class:`~perceiver_tpu.training.checkpoint.MultiModelStore` model
+    id); ``weight`` scales its fair share of the per-step prefill
+    token budget; ``max_pages`` bounds its KV arena footprint;
+    ``max_inflight`` and ``rate_per_s``/``burst`` bound it at the
+    router, before any compute.
+    """
+
+    tenant: str
+    model: Optional[str] = None
+    priority: int = PRIORITY_STANDARD
+    weight: float = 1.0
+    max_pages: Optional[int] = None
+    max_inflight: Optional[int] = None
+    rate_per_s: Optional[float] = None
+    burst: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.tenant:
+            raise ValueError("tenant name must be non-empty")
+        if self.priority < 0:
+            raise ValueError(f"priority must be >= 0, got {self.priority}")
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.max_pages is not None and self.max_pages < 1:
+            raise ValueError(
+                f"max_pages must be >= 1, got {self.max_pages}")
+        if self.max_inflight is not None and self.max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {self.max_inflight}")
+        if self.rate_per_s is not None and self.rate_per_s <= 0:
+            raise ValueError(
+                f"rate_per_s must be > 0, got {self.rate_per_s}")
+        if self.burst is not None and self.burst < 1:
+            raise ValueError(f"burst must be >= 1, got {self.burst}")
+
+
+class _Bucket:
+    """Token bucket for one tenant's request rate (registry-locked)."""
+
+    __slots__ = ("rate", "burst", "tokens", "last")
+
+    def __init__(self, rate: float, burst: int, now: float):
+        self.rate = rate
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.last = now
+
+
+class TenantRegistry:
+    """Thread-safe tenant directory + rate admission.
+
+    Unknown tenants resolve to :data:`DEFAULT_TENANT`'s spec (an
+    uncapped standard-priority spec unless one was registered), so a
+    single-tenant deployment never has to mention tenancy at all.
+    """
+
+    # lock discipline (gated by check.py --race): the spec map and the
+    # per-tenant rate buckets are written by register()/admit() from
+    # client threads and read from the router/engine hot paths
+    _GUARDED = {
+        "_tenants": "_lock",
+        "_buckets": "_lock",
+    }
+
+    def __init__(self, specs: Sequence[TenantSpec] = (), *,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, TenantSpec] = {}
+        self._buckets: Dict[str, _Bucket] = {}
+        for spec in specs:
+            self.register(spec)
+
+    def register(self, spec: TenantSpec) -> None:
+        """Add or replace one tenant's spec (rate bucket resets)."""
+        with self._lock:
+            self._tenants[spec.tenant] = spec
+            self._buckets.pop(spec.tenant, None)
+
+    def get(self, tenant: Optional[str]) -> TenantSpec:
+        """Resolve a tenant name to its spec — unknown names (and
+        ``None``) fall back to the default tenant's spec."""
+        name = tenant or DEFAULT_TENANT
+        with self._lock:
+            spec = self._tenants.get(name)
+            if spec is None:
+                spec = self._tenants.get(DEFAULT_TENANT)
+        if spec is not None and spec.tenant == name:
+            return spec
+        if spec is not None:
+            # default spec applied to an unregistered name: caps and
+            # weight inherit, identity stays the caller's
+            return dataclasses.replace(spec, tenant=name)
+        return TenantSpec(tenant=name)
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def admit(self, tenant: Optional[str],
+              now: Optional[float] = None) -> Tuple[bool, float]:
+        """Charge one request against the tenant's rate bucket.
+        Returns ``(admitted, retry_after_s)`` — ``retry_after_s`` is
+        the time until one token refills when the bucket is dry, 0.0
+        when admitted or unlimited."""
+        spec = self.get(tenant)
+        if spec.rate_per_s is None:
+            return True, 0.0
+        if now is None:
+            now = self._clock()
+        burst = spec.burst if spec.burst is not None \
+            else max(1, int(spec.rate_per_s))
+        with self._lock:
+            bucket = self._buckets.get(spec.tenant)
+            if bucket is None:
+                bucket = _Bucket(spec.rate_per_s, burst, now)
+                self._buckets[spec.tenant] = bucket
+            bucket.tokens = min(
+                bucket.burst,
+                bucket.tokens + (now - bucket.last) * bucket.rate)
+            bucket.last = now
+            if bucket.tokens >= 1.0:
+                bucket.tokens -= 1.0
+                return True, 0.0
+            return False, (1.0 - bucket.tokens) / bucket.rate
+
+
+def weighted_fair_shares(total: int, weights: Dict[str, float]
+                         ) -> Dict[str, int]:
+    """Split ``total`` integer units across keys proportionally to
+    ``weights`` with deterministic largest-remainder rounding (ties
+    break by key, so two runs over the same inputs always agree).
+    Every key with positive weight gets >= 1 unit while units remain
+    (a zero share would starve a tenant outright)."""
+    keys = sorted(weights)
+    if not keys or total <= 0:
+        return {k: 0 for k in keys}
+    wsum = float(sum(weights[k] for k in keys))
+    if wsum <= 0:
+        raise ValueError("weights must sum to > 0")
+    exact = {k: total * weights[k] / wsum for k in keys}
+    shares = {k: int(exact[k]) for k in keys}
+    left = total - sum(shares.values())
+    by_remainder = sorted(keys, key=lambda k: (shares[k] - exact[k], k))
+    for k in by_remainder:
+        if left <= 0:
+            break
+        shares[k] += 1
+        left -= 1
+    # floor-of-one pass: while units exist, no positive-weight tenant
+    # is shut out (take from the largest share, never below 1)
+    if total >= len(keys):
+        for k in keys:
+            if shares[k] == 0:
+                donor = max(keys, key=lambda d: (shares[d], d))
+                if shares[donor] > 1:
+                    shares[donor] -= 1
+                    shares[k] += 1
+    return shares

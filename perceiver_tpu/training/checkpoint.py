@@ -455,3 +455,49 @@ def restore_params(path: str, template: Any = None) -> Any:
         return got.get("params", got) if isinstance(got, dict) \
             else got
     raise FileNotFoundError(f"No checkpoint found under {path}")
+
+
+class MultiModelStore:
+    """Directory of per-model :class:`ParamsVersionStore` substores.
+
+    Layout: ``<dir>/<model>/<version>/params/...`` — each model id owns
+    an independent sealed-version directory with its own ``CURRENT``
+    pointer, so per-tenant rolling updates (docs/SERVING.md
+    "Multi-tenancy") stage/commit one model's version without touching
+    any other model's pointer. Model ids share the version-name rules
+    (no separators, not ``CURRENT``); substores are created lazily on
+    first reference and cached.
+    """
+
+    # lock discipline (gated by check.py --race): the substore cache is
+    # populated lazily from replica dispatch threads and the rollout
+    # driver concurrently
+    _GUARDED = {"_stores": "_lock"}
+
+    def __init__(self, directory: str):
+        self.directory = _abs(directory)
+        self._lock = threading.Lock()
+        self._stores: Dict[str, ParamsVersionStore] = {}
+        os.makedirs(self.directory, exist_ok=True)
+
+    def model(self, model_id: str) -> ParamsVersionStore:
+        """The (lazily created) version store for ``model_id``."""
+        if not model_id or os.sep in model_id \
+                or model_id == ParamsVersionStore.CURRENT_NAME \
+                or model_id.startswith("."):
+            raise ValueError(f"bad model id {model_id!r}")
+        with self._lock:
+            store = self._stores.get(model_id)
+            if store is None:
+                store = ParamsVersionStore(
+                    os.path.join(self.directory, model_id))
+                self._stores[model_id] = store
+            return store
+
+    def models(self):
+        """Model ids with an on-disk substore, sorted (lazily created
+        but still-empty substores count — they have a directory)."""
+        return sorted(
+            d for d in os.listdir(self.directory)
+            if os.path.isdir(os.path.join(self.directory, d))
+            and not d.startswith("."))

@@ -44,6 +44,14 @@ engine, gating token-exactness, acceptance rate
 the plain arm, and zero post-warmup compiles in both arms
 (docs/SERVING.md "Speculative decoding").
 
+``--tenants`` runs the mixed-tenant two-arm trace: the same "gold"
+plans solo, then under a quota-capped best-effort "bronze" flood on
+one tenancy-enabled engine. Emits per-tenant TTFT/p95/tokens-per-step
+and gates zero dropped gold requests, the noisy-neighbor isolation
+ratio (``--tenant-isolation-gate``, default 2x solo), at least one
+typed bronze ``tenant_quota`` shed, and zero post-warmup compiles
+(docs/SERVING.md "Multi-tenancy").
+
 The TTFT phase breakdown is derived from the request trace spans
 (``obs/trace.py``): per stream, ``queue_wait`` (admission), the
 ``prefill_chunk`` steps before the one that completed the prompt, and
@@ -301,6 +309,231 @@ def _run_speculative(args, task, geometry, plans):
     return code, result
 
 
+def _run_tenants(args, task, geometry, plans):
+    """The ``--tenants`` mixed-tenant two-arm trace.
+
+    Arm A (solo) decodes the plans as the "gold" tenant alone; arm B
+    (mixed) replays the SAME gold plans while a best-effort "bronze"
+    tenant floods the engine with ``--tenant-flood-factor`` extra
+    requests per gold submit — far more work than bronze's page quota
+    admits, so the surplus must shed with typed
+    ``Unavailable("tenant_quota")`` at submit, before any compute.
+    Emits per-tenant TTFT/p95/tokens-per-step in the result detail.
+    Four hard gates:
+
+    - **zero dropped gold requests** — every gold stream completes
+      with its full token count in BOTH arms;
+    - **isolation ratio** — gold's mixed-arm TTFT p95 AND inter-token
+      gap p95 must each stay <= ``--tenant-isolation-gate`` x its solo
+      baseline (the noisy-neighbor budget, chaos-gated
+      deterministically by ``scripts/chaos.py --scenario
+      noisy_neighbor``);
+    - **the flood was real** — bronze must hit its quota at least once
+      (a bench where nothing sheds proves nothing);
+    - **zero post-warmup compiles** in both arms — tenancy is
+      host-side state only (docs/SERVING.md "Multi-tenancy").
+    """
+    from perceiver_tpu.serving.decode import DecodeEngine
+    from perceiver_tpu.serving.errors import Unavailable
+    from perceiver_tpu.serving.tenancy import (
+        PRIORITY_BEST_EFFORT,
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    from dataclasses import replace
+
+    pages_per = math.ceil((args.prompt_len + args.max_new_max)
+                          / geometry.page_size)
+    tenancy = TenantRegistry([
+        TenantSpec(tenant="gold", weight=3.0),
+        # quota sized for ~2 in-flight bronze requests: the flood
+        # factor oversubscribes it several times over
+        TenantSpec(tenant="bronze", priority=PRIORITY_BEST_EFFORT,
+                   weight=1.0, max_pages=2 * pages_per),
+    ])
+    flood_prompt = np.asarray(plans[0][0], np.int32)
+    flood_new = args.max_new_min
+    # capacity-plan the pool from the quotas: bronze's page cap bounds
+    # its in-flight streams, so the slot axis gets exactly that much
+    # flood headroom on top of the gold concurrency — a quota'd tenant
+    # must never cost the victim a SLOT, only shed its own surplus
+    bronze_req_pages = geometry.pages_for(
+        flood_prompt.size + flood_new - 1)
+    flood_slots = max(1, (2 * pages_per) // bronze_req_pages)
+    geometry = replace(
+        geometry,
+        max_streams=geometry.max_streams + flood_slots,
+        num_pages=geometry.num_pages + flood_slots * bronze_req_pages)
+
+    def _arm(mixed: bool):
+        engine = DecodeEngine(
+            task, geometry=geometry, auto_step=True,
+            max_queue=args.streams * (1 + args.tenant_flood_factor) + 1,
+            token_budget=args.token_budget or None,
+            tenancy=tenancy)
+        emit_times = [[] for _ in plans]
+
+        def tracker(i):
+            def on_token(tok):
+                emit_times[i].append(time.monotonic())
+            return on_token
+
+        t0 = time.monotonic()
+        shed = 0
+        bronze_handles = []
+        with _compile_events() as compiles:
+            handles = []
+            for i, (prompt, max_new, _a) in enumerate(plans):
+                if mixed:
+                    for _ in range(args.tenant_flood_factor):
+                        try:
+                            bronze_handles.append(engine.submit(
+                                flood_prompt,
+                                max_new_tokens=flood_new,
+                                tenant="bronze"))
+                        except Unavailable as e:
+                            assert e.reason == "tenant_quota", e.reason
+                            shed += 1
+                handles.append(engine.submit(
+                    prompt, max_new_tokens=max_new, tenant="gold",
+                    on_token=tracker(i)))
+                time.sleep(0.01)
+            results = [h.result(timeout=600.0) for h in handles]
+            bronze_results = [h.result(timeout=600.0)
+                              for h in bronze_handles]
+        wall = time.monotonic() - t0
+        steps = engine.metrics.counter(
+            "serving_decode_steps_total",
+            "decode step executions").value
+        gold_tokens = engine._m_tenant_tokens.value_of(tenant="gold")
+        bronze_tokens = engine._m_tenant_tokens.value_of(
+            tenant="bronze")
+        shed_metric = engine._m_tenant_shed.value_of(
+            tenant="bronze", reason="tenant_quota")
+        gold_shed_metric = sum(
+            engine._m_tenant_shed.value_of(tenant="gold", reason=r)
+            for r in ("tenant_quota", "queue_full", "deadline"))
+        engine.close()
+        dropped = sum(1 for r in results
+                      if getattr(r, "finished", None) != "complete")
+        gaps = []
+        for times in emit_times:
+            gaps.extend((1e3 * np.diff(np.asarray(times,
+                                                  np.float64))).tolist())
+        bronze_done = sum(
+            1 for r in bronze_results
+            if getattr(r, "finished", None) == "complete")
+        return {
+            "ttft_ms": [1e3 * r.ttft_s for r in results
+                        if getattr(r, "finished", None) == "complete"],
+            "gaps_ms": gaps,
+            "dropped_gold": dropped,
+            "steps": int(steps),
+            "wall_s": round(wall, 2),
+            "compiles": len(compiles),
+            "gold_tokens": int(gold_tokens),
+            "bronze_tokens": int(bronze_tokens),
+            "bronze_submitted": len(bronze_handles) + shed,
+            "bronze_completed": bronze_done,
+            "bronze_quota_shed": shed,
+            "bronze_shed_metric": int(shed_metric),
+            "gold_shed_metric": int(gold_shed_metric),
+        }
+
+    solo = _arm(mixed=False)
+    mixed = _arm(mixed=True)
+
+    ttft_ratio = _pct(mixed["ttft_ms"], 95) / _pct(solo["ttft_ms"], 95)
+    gap_ratio = _pct(mixed["gaps_ms"], 95) / _pct(solo["gaps_ms"], 95)
+    dropped_ok = solo["dropped_gold"] == 0 and mixed["dropped_gold"] == 0
+    iso_ok = (ttft_ratio <= args.tenant_isolation_gate
+              and gap_ratio <= args.tenant_isolation_gate)
+    flood_ok = mixed["bronze_quota_shed"] >= 1 \
+        and mixed["bronze_shed_metric"] >= mixed["bronze_quota_shed"]
+    compiles_ok = solo["compiles"] == 0 and mixed["compiles"] == 0
+
+    def _tenant_detail(arm, tenant):
+        if tenant == "gold":
+            return {
+                "ttft_p50_ms": round(_pct(arm["ttft_ms"], 50), 3),
+                "ttft_p95_ms": round(_pct(arm["ttft_ms"], 95), 3),
+                "gap_p50_ms": round(_pct(arm["gaps_ms"], 50), 3),
+                "gap_p95_ms": round(_pct(arm["gaps_ms"], 95), 3),
+                "gap_p99_ms": round(_pct(arm["gaps_ms"], 99), 3),
+                "tokens": arm["gold_tokens"],
+                "tokens_per_step": round(
+                    arm["gold_tokens"] / max(1, arm["steps"]), 4),
+                "dropped": arm["dropped_gold"],
+                "shed": arm["gold_shed_metric"],
+            }
+        return {
+            "submitted": arm["bronze_submitted"],
+            "completed": arm["bronze_completed"],
+            "quota_shed": arm["bronze_quota_shed"],
+            "tokens": arm["bronze_tokens"],
+            "tokens_per_step": round(
+                arm["bronze_tokens"] / max(1, arm["steps"]), 4),
+        }
+
+    import jax
+    dev = jax.devices()[0]
+    result = {
+        "metric": "decode_tenant_isolation_ratio",
+        "value": round(max(ttft_ratio, gap_ratio), 4),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "detail": {
+            "preset": args.preset,
+            "geometry": geometry.descriptor,
+            "streams": args.streams,
+            "flood_factor": args.tenant_flood_factor,
+            "bronze_max_pages": 2 * pages_per,
+            "isolation_gate": args.tenant_isolation_gate,
+            "ttft_ratio": round(ttft_ratio, 4),
+            "gap_p95_ratio": round(gap_ratio, 4),
+            "solo": {"gold": _tenant_detail(solo, "gold"),
+                     "steps": solo["steps"], "wall_s": solo["wall_s"]},
+            "mixed": {"gold": _tenant_detail(mixed, "gold"),
+                      "bronze": _tenant_detail(mixed, "bronze"),
+                      "steps": mixed["steps"],
+                      "wall_s": mixed["wall_s"]},
+            "post_warmup_compiles": solo["compiles"]
+            + mixed["compiles"],
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+        },
+    }
+    line = json.dumps(result)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    if not dropped_ok:
+        print(f"[bench_decode] FAIL: dropped gold requests (solo "
+              f"{solo['dropped_gold']}, mixed {mixed['dropped_gold']}) "
+              f"— a quota'd neighbor must never cost the victim a "
+              f"request", file=sys.stderr)
+    if not iso_ok:
+        print(f"[bench_decode] FAIL: gold degradation under the "
+              f"bronze flood exceeds the isolation budget (ttft "
+              f"{ttft_ratio:.3f}x, gap p95 {gap_ratio:.3f}x, gate "
+              f"{args.tenant_isolation_gate}x)", file=sys.stderr)
+    if not flood_ok:
+        print(f"[bench_decode] FAIL: bronze never hit its quota "
+              f"(shed {mixed['bronze_quota_shed']}, metric "
+              f"{mixed['bronze_shed_metric']}) — the flood proved "
+              f"nothing", file=sys.stderr)
+    if not compiles_ok:
+        print(f"[bench_decode] FAIL: post-warmup XLA compiles (solo "
+              f"{solo['compiles']}, mixed {mixed['compiles']}) — "
+              f"tenancy must stay host-side state only",
+              file=sys.stderr)
+    code = 0 if (dropped_ok and iso_ok and flood_ok and compiles_ok) \
+        else 1
+    return code, result
+
+
 def run(argv=None):
     """The bench body: returns ``(exit_code, result_dict)`` so tests
     can drive it in-process; ``main`` wraps it for the CLI."""
@@ -358,12 +591,25 @@ def run(argv=None):
                     help="speculative acceptance rate must be >= this "
                          "(self-draft proposes from the target's own "
                          "weights, so ~1.0)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="two-arm mixed-tenant trace: a solo 'gold' "
+                         "arm, then the same gold plans under a "
+                         "quota-capped best-effort 'bronze' flood; "
+                         "emits per-tenant TTFT/p95/tokens-per-step "
+                         "and gates the isolation ratio "
+                         "(docs/SERVING.md \"Multi-tenancy\")")
+    ap.add_argument("--tenant-flood-factor", type=int, default=2,
+                    help="bronze submissions per gold submit in the "
+                         "mixed arm (default 2)")
+    ap.add_argument("--tenant-isolation-gate", type=float, default=2.0,
+                    help="gold's mixed-arm ttft p95 and gap p95 must "
+                         "each stay <= gate x its solo baseline")
     ap.add_argument("--out", default=None,
                     help="also write the result JSON to this path")
     args = ap.parse_args(argv)
-    if args.speculative and args.shared_prefix:
-        ap.error("--speculative and --shared-prefix are separate "
-                 "traces; run them as two invocations")
+    if sum((args.speculative, args.shared_prefix, args.tenants)) > 1:
+        ap.error("--speculative, --shared-prefix and --tenants are "
+                 "separate traces; run them as separate invocations")
 
     from perceiver_tpu.obs import trace as trace_mod
     from perceiver_tpu.serving.decode import DecodeEngine, DecodeGeometry
@@ -435,6 +681,9 @@ def run(argv=None):
 
     if args.speculative:
         return _run_speculative(args, task, geometry, plans)
+
+    if args.tenants:
+        return _run_tenants(args, task, geometry, plans)
 
     prefix_cfg = None
     if args.shared_prefix:

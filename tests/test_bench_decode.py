@@ -148,3 +148,52 @@ def test_bench_decode_seeded_o1_violation_exits_nonzero(bench):
     code, result = bench.run(_FAST_ARGS + ["--gate-ratio", "0.0001"])
     assert code == 1
     assert result["detail"]["o1_ratio"] > 0.0001
+
+
+@pytest.fixture(scope="module")
+def tenants_run(bench):
+    """One mixed-tenant two-arm run shared by the assertions below.
+
+    Same jitter story as the other in-process runs: at 4 tiny streams
+    both arms' p95s are maxima over a handful of ~ms samples, so the
+    production 2.0x isolation gate is relaxed to 4.0x (BENCH_r20.json
+    holds the production gate at real scale); the per-tenant counters
+    and typed sheds asserted below are deterministic either way."""
+    return bench.run(_FAST_ARGS + ["--tenants",
+                                   "--tenant-isolation-gate", "4.0"])
+
+
+def test_bench_decode_tenants_gates_pass(tenants_run):
+    code, result = tenants_run
+    assert code == 0, result["detail"]
+    d = result["detail"]
+    assert result["metric"] == "decode_tenant_isolation_ratio"
+    assert d["post_warmup_compiles"] == 0
+    # zero dropped gold requests in either arm, and gold never shed
+    assert d["solo"]["gold"]["dropped"] == 0
+    assert d["mixed"]["gold"]["dropped"] == 0
+    assert d["mixed"]["gold"]["shed"] == 0
+    # the flood was real: bronze oversubscribed its quota and the
+    # surplus shed typed, observable in the per-tenant counter
+    bronze = d["mixed"]["bronze"]
+    assert bronze["quota_shed"] >= 1
+    assert bronze["submitted"] == 2 * d["streams"]
+    assert bronze["completed"] + bronze["quota_shed"] \
+        <= bronze["submitted"]
+    # per-tenant emissions are populated for both tenants
+    for tenant in ("gold", "bronze"):
+        assert d["mixed"][tenant]["tokens_per_step"] >= 0
+    assert d["mixed"]["gold"]["ttft_p95_ms"] > 0
+    assert d["ttft_ratio"] <= d["isolation_gate"]
+    assert d["gap_p95_ratio"] <= d["isolation_gate"]
+
+
+def test_bench_decode_seeded_tenant_violation_exits_nonzero(bench):
+    """An impossible isolation gate must flip the exit code — the
+    mixed arm's gold TTFT is a real measurement > 0, so a near-zero
+    allowed ratio cannot pass."""
+    code, result = bench.run(
+        _FAST_ARGS + ["--tenants", "--tenant-isolation-gate", "0.0001"])
+    assert code == 1
+    assert max(result["detail"]["ttft_ratio"],
+               result["detail"]["gap_p95_ratio"]) > 0.0001

@@ -64,6 +64,20 @@ def test_chaos_fast_matrix_survives():
     assert storm["rejected_tokens"] >= 1
     assert storm["deterministic_replays"] == len(storm["seeds"])
     assert storm["faults_fired"].get("spec.reject_storm", 0) >= 1
+    # multi-tenant noisy neighbor (ISSUE 20): a quota-busting
+    # best-effort flood on the shared decode arena — the victim loses
+    # zero requests, stays within the pinned latency ratio of its solo
+    # baseline, the flood sheds typed and tenant-labelled, tenancy
+    # mints zero post-warmup compiles, and seeded runs replay bitwise
+    nn = by_metric["chaos_noisy_neighbor"]["detail"]
+    assert nn["victim_dropped"] == 0
+    assert nn["ttft_ratio_max"] <= nn["pinned_ratio"]
+    assert nn["gap_ratio_max"] <= nn["pinned_ratio"]
+    assert nn["flood_shed"] >= 1
+    assert nn["tenant_shed_events"] >= nn["flood_shed"]
+    assert nn["post_warmup_compiles"] == 0
+    assert nn["deterministic_replays"] == len(nn["seeds"])
+    assert nn["faults_fired"].get("tenant.flood", 0) >= 1
 
 
 def test_chaos_fleet_fast_survives():

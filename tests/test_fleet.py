@@ -11,6 +11,7 @@ piece whose behavior lives in the kernel). End-to-end fleet behavior
 
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -432,7 +433,7 @@ def test_rolling_update_updates_all_and_moves_current(tmp_path):
     handles = {"r0": FakeHandle(), "r1": FakeHandle(), "r2": FakeHandle()}
     fleet = FakeRolloutFleet(handles, store.directory)
     summary = rolling_update(fleet, "v2")
-    assert summary == {"version": "v2", "previous": "v1",
+    assert summary == {"version": "v2", "previous": "v1", "model": None,
                        "replicas": ["r0", "r1", "r2"], "updated": 3}
     assert all(h.updates == ["v2"] for h in handles.values())
     assert store.current() == "v2"
@@ -729,3 +730,238 @@ def test_rpc_client_connect_refused_is_rpc_error():
     with pytest.raises(RpcError):
         client.call("ping")
     client.close()
+
+
+# --- multi-tenant fleet: per-tenant cutovers on multi-model replicas ---------
+
+_MT_TASK_KWARGS = dict(
+    vocab_size=110, max_seq_len=32, num_latents=4,
+    num_latent_channels=8, num_encoder_layers=1,
+    num_encoder_self_attention_layers_per_block=1,
+    num_encoder_cross_attention_heads=1,
+    num_encoder_self_attention_heads=1,
+    num_decoder_cross_attention_heads=1, loss_impl="dense")
+
+
+def _publish_model(root, model, versions, start_seed=0):
+    """Publish fresh-init param versions into one model's substore."""
+    from perceiver_tpu.serving.graphs import build_serve_graph
+    from perceiver_tpu.tasks import MaskedLanguageModelTask
+    from perceiver_tpu.training.checkpoint import MultiModelStore
+
+    graph = build_serve_graph(MaskedLanguageModelTask(**_MT_TASK_KWARGS))
+    store = MultiModelStore(root).model(model)
+    for i, v in enumerate(versions):
+        store.publish(v, graph.init_params(start_seed + i),
+                      set_current=(i == 0))
+    return store
+
+
+def _corrupt_version(store, version):
+    """Truncate the largest non-manifest blob of one sealed version."""
+    blobs = []
+    for walk_root, _, names in os.walk(store.path(version)):
+        blobs.extend(os.path.join(walk_root, n) for n in names
+                     if "manifest" not in n)
+    target = max(blobs, key=os.path.getsize)
+    with open(target, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(target) // 2))
+
+
+def _mt_spec(root):
+    """Two models on one replica: tenant A rides "ma", tenant B "mb"."""
+    return {
+        "task_class": "MaskedLanguageModelTask",
+        "task_kwargs": _MT_TASK_KWARGS,
+        "batch_buckets": [1],
+        "seq_buckets": [16],
+        "model_store_dir": root,
+        "models": {"ma": "v1", "mb": "v1"},
+        "decode": {"max_streams": 2, "num_pages": 9, "page_size": 4,
+                   "max_seq_len": 32, "max_new_tokens_default": 4},
+        "tenants": [{"tenant": "A", "model": "ma"},
+                    {"tenant": "B", "model": "mb"}],
+    }
+
+
+def test_per_tenant_two_phase_cutover_over_rpc(tmp_path):
+    """ISSUE 20: the r13 stage/commit two-phase cutover, scoped to ONE
+    model over a real socket. Staging verifies and loads the new tree
+    beside the live one (serving untouched, both models keep answering
+    on v1), commit swaps only the staged model, and a commit without a
+    matching stage is a protocol error. The other model's version
+    pointer and dispatches never notice."""
+    from perceiver_tpu.fleet.replica import ReplicaServer
+    from perceiver_tpu.fleet.supervisor import RpcReplicaHandle
+
+    root = str(tmp_path / "models")
+    _publish_model(root, "ma", ("v1", "v2"))
+    _publish_model(root, "mb", ("v1",), start_seed=10)
+    replica = ReplicaServer(_mt_spec(root))
+    handle = RpcReplicaHandle("127.0.0.1", replica.server.port,
+                              dispatch_timeout_s=60.0)
+    prompt = np.asarray([5, 9, 13], np.int32)
+    try:
+        st = handle.status()
+        assert st["models"] == ["ma", "mb"]
+        assert st["model_versions"] == {"ma": "v1", "mb": "v1"}
+
+        # phase 1: stage ma's v2 — serving state untouched
+        assert handle.stage_version("v2", model="ma") \
+            == {"staged": "v2", "model": "ma"}
+        st = handle.status()
+        assert st["model_staged"] == {"ma": "v2"}
+        assert st["model_versions"] == {"ma": "v1", "mb": "v1"}
+        for model in ("ma", "mb"):
+            reply = handle.dispatch({"prompt_ids": prompt,
+                                     "model": model, "tenant": "x"})
+            assert reply["outputs"]["tokens"].shape == (4,)
+            assert reply["version"] == "v1"
+
+        # phase 2: commit swaps ONLY the staged model
+        assert handle.commit_version("v2", model="ma") \
+            == {"version": "v2", "model": "ma"}
+        st = handle.status()
+        assert st["model_versions"] == {"ma": "v2", "mb": "v1"}
+        assert st["model_staged"] == {}
+
+        # the protocol is enforced: commit requires a matching stage
+        with pytest.raises(BatchError, match="two-phase"):
+            handle.commit_version("v9", model="mb")
+        # abort drops a staged tree without touching the live one
+        handle.stage_version("v1", model="ma")
+        assert handle.abort_version(model="ma") \
+            == {"aborted": "v1", "model": "ma"}
+        assert handle.status()["model_versions"]["ma"] == "v2"
+    finally:
+        handle.close()
+        replica.close()
+
+
+def test_per_tenant_rolling_update_under_load_over_rpc(tmp_path):
+    """ISSUE 20 satellite: updating tenant A's params never interrupts
+    tenant B's in-flight streams — a two-replica real-socket fleet
+    with tenant B streaming decode requests through the router for the
+    whole test, while tenant A's model (1) rolls to v2 cleanly and
+    (2) attempts a roll to v3 that corrupts mid-rollout and
+    auto-rolls back on the typed ``CheckpointIntegrityError``. Zero
+    tenant-B failures across both rollouts; only ma's CURRENT moves."""
+    from perceiver_tpu.fleet.replica import ReplicaServer
+    from perceiver_tpu.fleet.supervisor import RpcReplicaHandle
+    from perceiver_tpu.serving.tenancy import TenantRegistry, TenantSpec
+    from perceiver_tpu.training.checkpoint import MultiModelStore
+
+    root = str(tmp_path / "models")
+    store_a = _publish_model(root, "ma", ("v1", "v2", "v3"))
+    store_b = _publish_model(root, "mb", ("v1",), start_seed=10)
+    spec = _mt_spec(root)
+    replicas = [ReplicaServer(spec) for _ in range(2)]
+    handles = {
+        f"r{i}": RpcReplicaHandle("127.0.0.1", r.server.port,
+                                  dispatch_timeout_s=60.0)
+        for i, r in enumerate(replicas)
+    }
+    # real clock/sleep: wait_idle must see tenant B's in-flight drain
+    router = Router(prober_interval_s=None, retry_backoff_s=0.01,
+                    tenancy=TenantRegistry([
+                        TenantSpec(tenant="A", model="ma"),
+                        TenantSpec(tenant="B", model="mb")]))
+
+    class _Fleet:  # the rollout driver's fleet surface
+        def __init__(self):
+            self.spec = dict(spec)
+            self.router = router
+            self.supervisor = FakeSupervisor(handles, dict(spec))
+
+    fleet = _Fleet()
+    stop = threading.Event()
+    b_errors, b_ok = [], [0]
+    prompt = np.asarray([5, 9, 13], np.int32)
+
+    def b_load():
+        # tenant B's live traffic: continuous decode streams routed by
+        # the tenant's spec (model mb) — ANY failure ends the loop
+        while not stop.is_set():
+            try:
+                reply = router.submit(
+                    {"prompt_ids": prompt,
+                     "max_new_tokens": np.asarray(4, np.int32)},
+                    tenant="B")
+                assert reply["outputs"]["tokens"].shape == (4,)
+                b_ok[0] += 1
+            except BaseException as e:  # noqa: BLE001 — the assertion
+                b_errors.append(e)
+                return
+
+    loader = threading.Thread(target=b_load, daemon=True)
+    try:
+        for rid, handle in handles.items():
+            router.add(rid, handle)
+        loader.start()
+        deadline = time.monotonic() + 30.0
+        while b_ok[0] < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert b_ok[0] >= 3, b_errors  # B traffic flowing pre-rollout
+
+        # clean per-tenant rollout: ma -> v2 on every replica
+        before = b_ok[0]
+        summary = rolling_update(fleet, "v2", model="ma",
+                                 drain_timeout_s=30.0)
+        assert summary == {"version": "v2", "previous": "v1",
+                           "model": "ma", "replicas": ["r0", "r1"],
+                           "updated": 2}
+        assert store_a.current() == "v2"
+        assert fleet.spec["models"]["ma"] == "v2"
+
+        # corrupt v3 only after r0 already cut over — r1's verified
+        # load fails typed, and the driver rolls r0 back to v2
+        corrupted = [False]
+
+        def corrupt_after_first(rid):
+            if not corrupted[0]:
+                _corrupt_version(store_a, "v3")
+                corrupted[0] = True
+
+        with pytest.raises(RolloutAborted) as abort:
+            rolling_update(fleet, "v3", model="ma",
+                           drain_timeout_s=30.0,
+                           on_replica_updated=corrupt_after_first)
+        assert isinstance(abort.value.cause, CheckpointIntegrityError)
+        assert abort.value.rolled_back == ["r0"]
+        assert abort.value.rollback_failed == []
+        # CURRENT never moved off the last good version, the fleet
+        # converged back to it, and mb was never touched at all
+        assert store_a.current() == "v2"
+        assert store_b.current() == "v1"
+        assert fleet.spec["models"] == {"ma": "v2", "mb": "v1"}
+        for handle in handles.values():
+            st = handle.status()
+            assert st["model_versions"] == {"ma": "v2", "mb": "v1"}
+            assert st["model_swapping"] == []
+
+        # B streamed through BOTH rollouts without a single failure
+        during = b_ok[0] - before
+        assert during > 0, "no tenant-B traffic overlapped the rollout"
+        stop.set()
+        loader.join(30.0)
+        assert b_errors == []
+        assert router.metrics.get("fleet_tenant_requests_total") \
+            .value_of(tenant="B", outcome="ok") == b_ok[0]
+
+        # the fix for tenant-stamped rectangular payloads: the wire
+        # envelope's routing keys must not break exact-input checks
+        rng = np.random.default_rng(0)
+        rect = router.submit({
+            "input_ids": rng.integers(3, 110, (1, 16)).astype(np.int32),
+            "pad_mask": np.zeros((1, 16), bool)}, tenant="A")
+        assert rect["outputs"]["filled_ids"].shape == (1, 16)
+
+        # the MultiModelStore's substores are genuinely disjoint dirs
+        assert MultiModelStore(root).models() == ["ma", "mb"]
+    finally:
+        stop.set()
+        router.close()
+        for handle in handles.values():
+            handle.close()
+        for replica in replicas:
+            replica.close()

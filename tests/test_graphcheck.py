@@ -582,12 +582,19 @@ def test_decode_targets_registered_and_budgeted():
 
     names = {t.name for t in DECODE_TARGETS}
     assert names == {"decode_mixed_mlm_r8_p64x16_q8",
-                     "decode_spec_mlm_r8_p64x16_q8_k4"}
+                     "decode_spec_mlm_r8_p64x16_q8_k4",
+                     "decode_multitenant_mlm_r8_p64x16_q8"}
     assert all(t.kind == "decode" for t in DECODE_TARGETS)
+    # the multi-tenant target must be a signature twin of the plain
+    # mixed step: tenancy is host-side state, identical lowered graph
+    twins = {t.name: t.signature_twin for t in DECODE_TARGETS}
+    assert (twins["decode_multitenant_mlm_r8_p64x16_q8"]
+            == "decode_mixed_mlm_r8_p64x16_q8")
     canonical = {t.name for t in CANONICAL_TARGETS}
     assert names <= canonical
     spmd_names = {"decode_mixed_mlm_spmd_r8_p48x16_q8_dp2_tp2",
-                  "decode_spec_mlm_spmd_r8_p48x16_q8_k4_dp2_tp2"}
+                  "decode_spec_mlm_spmd_r8_p48x16_q8_k4_dp2_tp2",
+                  "decode_multitenant_mlm_spmd_r8_p48x16_q8_dp2_tp2"}
     assert spmd_names <= canonical
     assert names | spmd_names <= set(load_hbm_budgets())
     shard = load_shard_budgets()
@@ -1360,3 +1367,66 @@ def test_lint_kv_alias_suppression_marker():
         ".set(x)  # graphcheck: ignore — scratch buffer, not the arena")
     assert "kv-alias" not in _checks(
         suppressed, "perceiver_tpu/serving/other.py")
+
+
+# --- tenant-label-discipline (ISSUE 20: multi-tenant observability) ----------
+
+_TENANT_LABELS_BARE = """
+def record(counter):
+    counter.labels(reason="tenant_quota").inc()
+"""
+
+_TENANT_EMIT_BARE = """
+def record(log, stream_id):
+    log.emit("stream_open", stream=stream_id)
+"""
+
+_TENANT_CLEAN = """
+def record(counter, log, tenant, stream_id):
+    counter.labels(tenant=tenant, reason="tenant_quota").inc()
+    log.emit("stream_open", stream=stream_id, tenant=tenant)
+    emit("tenant_shed", tenant=tenant, reason="tenant_quota")
+"""
+
+
+def test_lint_tenant_label_discipline_seeded():
+    """An unlabeled series in a multi-tenant plane merges all tenants
+    — noisy-neighbor starvation becomes invisible exactly when it
+    matters. Both forms are in scope: metric .labels(...) sites and
+    string-literal event emits (bare or attribute call)."""
+    for path in ("perceiver_tpu/fleet/router.py",
+                 "perceiver_tpu/serving/decode.py",
+                 "perceiver_tpu/serving/batcher.py"):
+        assert "tenant-label-discipline" in _checks(
+            _TENANT_LABELS_BARE, path), path
+        assert "tenant-label-discipline" in _checks(
+            _TENANT_EMIT_BARE, path), path
+    # bare emit(...) calls (module-level helper import) also count
+    bare = 'def f(s):\n    emit("stream_close", stream=s)\n'
+    assert "tenant-label-discipline" in _checks(
+        bare, "perceiver_tpu/fleet/supervisor.py")
+
+
+def test_lint_tenant_label_discipline_clean_and_scope():
+    # a tenant= keyword on the call satisfies the rule
+    assert "tenant-label-discipline" not in _checks(
+        _TENANT_CLEAN, "perceiver_tpu/fleet/router.py")
+    # scoped to the multi-tenant planes: the same sites are fine in
+    # the single-tenant serving engine or the training loop
+    assert "tenant-label-discipline" not in _checks(
+        _TENANT_LABELS_BARE, "perceiver_tpu/serving/engine.py")
+    assert "tenant-label-discipline" not in _checks(
+        _TENANT_EMIT_BARE, "perceiver_tpu/training/loop.py")
+    # computed event types are out of scope for an AST pass
+    computed = _TENANT_EMIT_BARE.replace('"stream_open"', 'etype')
+    assert "tenant-label-discipline" not in _checks(
+        computed, "perceiver_tpu/fleet/router.py")
+
+
+def test_lint_tenant_label_discipline_suppression_marker():
+    suppressed = _TENANT_LABELS_BARE.replace(
+        ".inc()",
+        ".inc()  # graphcheck: ignore — aggregate series; tenant split"
+        " is fleet_tenant_requests_total")
+    assert "tenant-label-discipline" not in _checks(
+        suppressed, "perceiver_tpu/fleet/router.py")

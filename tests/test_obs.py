@@ -156,10 +156,11 @@ def test_decode_phases_and_stream_events_in_vocabulary():
                                                 "token_emit"]
 
     log = EventLog()
-    validate_event(log.emit("stream_open", stream="s1"))
-    validate_event(log.emit("stream_close", stream="s1", tokens=12))
+    validate_event(log.emit("stream_open", stream="s1", tenant="default"))
+    validate_event(log.emit("stream_close", stream="s1", tokens=12,
+                            tenant="default"))
     with pytest.raises(ValueError, match="missing required"):
-        log.emit("stream_close", stream="s1")  # tokens is required
+        log.emit("stream_close", stream="s1", tenant="default")  # tokens
     assert [e["type"] for e in log.events()] == ["stream_open",
                                                  "stream_close"]
 
@@ -179,11 +180,13 @@ def test_prefill_phases_and_scheduler_events_in_vocabulary():
     assert [s["phase"] for s in sink.spans] == ["prefill_chunk"]
 
     log = EventLog()
-    validate_event(log.emit("stream_admitted", stream="s1", pages=4))
+    validate_event(log.emit("stream_admitted", stream="s1", pages=4,
+                            tenant="default"))
     validate_event(log.emit("prefill_complete", stream="s1",
-                            prompt_tokens=9, chunks=2))
+                            prompt_tokens=9, chunks=2, tenant="default"))
     with pytest.raises(ValueError, match="missing required"):
-        log.emit("prefill_complete", stream="s1")  # counts required
+        log.emit("prefill_complete", stream="s1",
+                 tenant="default")  # counts required
     assert [e["type"] for e in log.events()] == ["stream_admitted",
                                                  "prefill_complete"]
 
